@@ -1,0 +1,24 @@
+"""ceph_tpu — a TPU-native erasure-coding and data-integrity framework.
+
+Implements the behavioral contract of Ceph's erasure-code subsystem
+(reference: /root/reference/src/erasure-code/ErasureCodeInterface.h:182)
+as an idiomatic JAX/XLA/Pallas framework:
+
+- GF(2^8) math as bit-sliced MXU matmuls (``ceph_tpu.gf``, ``ceph_tpu.ops``)
+- Code families: Reed-Solomon (Vandermonde / RAID6), Cauchy, the
+  Liberation XOR-schedule family, LRC, SHEC, CLAY (``ceph_tpu.codecs``)
+- The OSD EC stripe pipeline semantics — stripe geometry, extent maps,
+  read-modify-write planning, reconstruct reads, recovery, deep scrub
+  (``ceph_tpu.pipeline``)
+- Block checksumming (CRC32C family, xxhash32/64) (``ceph_tpu.checksum``)
+- Multi-chip shard fan-out over a jax.sharding.Mesh (``ceph_tpu.parallel``)
+- Native C++ host runtime (ring buffer, scalar validation paths)
+  (``ceph_tpu.runtime``)
+"""
+
+__version__ = "0.1.0"
+
+# Interface generation implemented: the 2025 "optimized EC" path
+# (reference: src/osd/ECSwitch.h:6-18). Mirrors __erasure_code_version
+# handshake in src/erasure-code/ErasureCodePlugin.cc:30-33.
+PLUGIN_ABI_VERSION = "ceph_tpu-ec-2.0"

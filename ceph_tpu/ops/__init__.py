@@ -1,0 +1,13 @@
+"""Device compute kernels: bit-plane GF engine, checksums, Pallas paths."""
+
+from .bitplane import (  # noqa: F401
+    unpack_bits,
+    pack_bits,
+    unpack_bits_lanes,
+    pack_bits_lanes,
+    mod2_matmul,
+    gf_encode_bitplane,
+    gf_mul_const_bytes,
+    packet_mod2_apply,
+    xor_bytes,
+)

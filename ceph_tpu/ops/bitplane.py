@@ -1,0 +1,140 @@
+"""Bit-plane GF(2^8) engine: erasure-code math as mod-2 MXU matmuls.
+
+The reference's hot loop is ``ec_encode_data`` / ``jerasure_matrix_encode``
+(SIMD GF multiply-accumulate over byte lanes, isa/ErasureCodeIsa.cc:268).
+TPUs have no pshufb-style byte table lookup, so we lower differently
+(SURVEY.md section 7): a GF(2^8) generator matrix G[m, k] becomes one
+binary matrix B[m*8, k*8] (each entry an 8x8 multiply-by-constant GF(2)
+block), data bytes become 8 bit-planes, and
+
+    parity_bits[m*8, N] = (B @ data_bits[k*8, N]) mod 2
+
+which the MXU executes as an int8 matmul with int32 accumulation (exact:
+max contraction 256 terms), followed by ``& 1`` and bit re-packing. The
+same engine runs decode (B = cached inverted submatrix rows), parity
+delta (B = single generator column), and the Liberation-family native
+bit-matrix codes (packet layout instead of byte bit-planes).
+
+All functions are shape-polymorphic over leading batch axes and jit/vmap
+friendly (static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _accum_dtypes() -> tuple[jnp.dtype, jnp.dtype]:
+    """(operand dtype, accumulator dtype) for the mod-2 matmul.
+
+    int8 x int8 -> int32 rides the MXU at full integer throughput on TPU
+    and is exact for our contraction sizes (<= 256 ones per row).
+    """
+    return jnp.int8, jnp.int32
+
+
+def unpack_bits(x: jax.Array) -> jax.Array:
+    """[..., S, N] uint8 -> [..., S*8, N] bits in {0,1} (LSB-first planes).
+
+    Row s*8+b of the output is bit b of shard s, matching the LSB-first
+    bit convention of ``ceph_tpu.gf.tables.mul_bitmatrix``.
+    """
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    b = (x[..., :, None, :] >> shifts[:, None]) & jnp.uint8(1)
+    return b.reshape(*x.shape[:-2], x.shape[-2] * 8, x.shape[-1])
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """[..., S*8, N] bits in {0,1} -> [..., S, N] uint8 (LSB-first)."""
+    s8, n = bits.shape[-2], bits.shape[-1]
+    assert s8 % 8 == 0, s8
+    b = bits.reshape(*bits.shape[:-2], s8 // 8, 8, n).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(b << shifts[:, None], axis=-2, dtype=jnp.uint8)
+
+
+def mod2_matmul(bmat: jax.Array, bits: jax.Array) -> jax.Array:
+    """(bmat @ bits) mod 2. bmat [R, C] in {0,1}; bits [..., C, N] in {0,1}.
+
+    Integer matmul with int32 accumulation, then parity of the count.
+    Deterministic regardless of reduction order (bit-compatibility
+    requirement — SURVEY.md section 7 "Hard parts").
+    """
+    op_dtype, acc_dtype = _accum_dtypes()
+    # Keep N in the minor (lane) dimension end-to-end: out[..., R, N] with
+    # bmat as LHS. The transposed formulation ([N, R] + relayout) measured
+    # 3500x slower on v5e; this form lets XLA fuse unpack -> int8 MXU
+    # matmul -> mod-2 -> pack into one kernel at HBM speed.
+    acc = jnp.einsum(
+        "rc,...cn->...rn",
+        bmat.astype(op_dtype),
+        bits.astype(op_dtype),
+        preferred_element_type=acc_dtype,
+    )
+    return (acc & 1).astype(jnp.uint8)
+
+
+def gf_encode_bitplane(bitmatrix: jax.Array, data: jax.Array) -> jax.Array:
+    """Apply a GF(2^8) code in bit-plane form.
+
+    ``bitmatrix``: [R*8, S*8] binary (from gf.gf_matrix_to_bitmatrix of an
+    [R, S] GF matrix). ``data``: [..., S, N] uint8 shards. Returns
+    [..., R, N] uint8 — parity shards for encode, reconstructed shards for
+    decode, delta contributions for apply_delta.
+    """
+    return pack_bits(mod2_matmul(bitmatrix, unpack_bits(data)))
+
+
+def xor_bytes(a: jax.Array, b: jax.Array) -> jax.Array:
+    """GF(2^8) addition — used by encode_delta (new XOR old, per
+    ErasureCodeInterface.h:471 parity-delta contract)."""
+    return jnp.bitwise_xor(a, b)
+
+
+def unpack_bits_lanes(x: jax.Array) -> jax.Array:
+    """[..., C, P] uint8 -> [..., C, P*8] bits, bit planes along lanes.
+
+    Element [..., c, p*8+b] is bit b of byte [..., c, p] (LSB-first).
+    """
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    b = (x[..., :, :, None] >> shifts) & jnp.uint8(1)
+    return b.reshape(*x.shape[:-1], x.shape[-1] * 8)
+
+
+def pack_bits_lanes(bits: jax.Array) -> jax.Array:
+    """Inverse of unpack_bits_lanes: [..., C, P*8] -> [..., C, P] uint8."""
+    p8 = bits.shape[-1]
+    assert p8 % 8 == 0, p8
+    b = bits.reshape(*bits.shape[:-1], p8 // 8, 8).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(b << shifts, axis=-1, dtype=jnp.uint8)
+
+
+def packet_mod2_apply(bitmatrix: jax.Array, packets: jax.Array) -> jax.Array:
+    """Native bit-matrix codes on the jerasure *packet* layout.
+
+    ``packets``: [..., C, P] uint8 where each of the C = k*w rows is a
+    packet of P bytes (chunk = w consecutive packets). Output row r is the
+    XOR of packets selected by bitmatrix row r — bytewise XOR. Unpacking
+    byte bits along the lane axis keeps the selection a single [R, C]
+    mod-2 matmul (XOR acts independently per bit lane).
+    """
+    bits = unpack_bits_lanes(packets)  # [..., C, P*8]
+    return pack_bits_lanes(mod2_matmul(bitmatrix, bits))
+
+
+def gf_mul_const_bytes(c: int, x: jax.Array) -> jax.Array:
+    """Multiply every byte by GF constant ``c`` (device path).
+
+    Used by apply_delta for single-coefficient parity updates; lowered via
+    the same 8x8 bit matrix so it stays table-free on TPU.
+    """
+    from ceph_tpu.gf.tables import mul_bitmatrix
+
+    m = jnp.asarray(mul_bitmatrix(c))
+    orig_shape = x.shape
+    flat = x.reshape(-1, 1, orig_shape[-1]) if x.ndim > 1 else x.reshape(1, 1, -1)
+    y = gf_encode_bitplane(m, flat)
+    return y.reshape(orig_shape)
