@@ -1,0 +1,67 @@
+"""Flagship benchmark: EC(8,4) Reed-Solomon batched stripe encode.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline target: 25 GB/s/chip on TPU v5e-1 (BASELINE.json north star).
+``vs_baseline`` is the ratio value / 25.
+
+Methodology mirrors the reference tool's shape
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc: big buffer,
+fixed iteration count, throughput = bytes/elapsed) with one TPU-ism:
+iterations are enqueued without per-call sync (per-dispatch sync
+latency through the device tunnel would measure the network, not the
+chip) and the clock stops on the final block_until_ready.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+K, M = 8, 4
+CHUNK = 1 << 20          # 1 MiB per shard
+BATCH = 8                # stripes per dispatch -> 64 MiB input per iter
+ITERS = 30
+TARGET_GBPS = 25.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
+    from ceph_tpu.ops.bitplane import gf_encode_bitplane
+
+    g = vandermonde_rs_matrix(K, M)
+    bmat = jnp.asarray(gf_matrix_to_bitmatrix(g[K:, :]))
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, (BATCH, K, CHUNK)).astype(np.uint8)
+    )
+    enc = jax.jit(gf_encode_bitplane)
+    enc(bmat, data).block_until_ready()  # compile + warm
+
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = enc(bmat, data)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    total_bytes = ITERS * BATCH * K * CHUNK
+    gbps = total_bytes / elapsed / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": f"EC({K},{M}) reed_sol_van batched stripe encode",
+                "value": round(gbps, 2),
+                "unit": "GB/s data-in per chip",
+                "vs_baseline": round(gbps / TARGET_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Erasure-code families behind one codec protocol.
+
+The TPU-native equivalent of the reference's plugin subsystem
+(src/erasure-code/ — SURVEY.md section 2.1): a registry of codec
+factories (``registry``), the abstract contract (``interface``), shared
+default behavior (``base``), and the code families:
+
+- ``jerasure``: reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good,
+  liberation, blaum_roth, liber8tion
+- ``isa``: Reed-Solomon Vandermonde + Cauchy with decode-table cache
+- ``lrc``: locally repairable layered codes
+- ``shec``: shingled erasure code
+- ``clay``: coupled-layer MSR regenerating code
+"""
+
+from .interface import (  # noqa: F401
+    ErasureCodec,
+    ErasureCodeProfile,
+    Flag,
+    SubChunkPlan,
+)
+from .registry import (  # noqa: F401
+    ErasureCodePluginRegistry,
+    registry,
+    create_codec,
+)
+
+# Register in-tree plugins (the analog of osd_erasure_code_plugins
+# preload — global.yaml.in:2638).
+from . import jerasure as _jerasure  # noqa: E402,F401
+from . import isa as _isa  # noqa: E402,F401

@@ -1,0 +1,146 @@
+"""Shared codec behavior — the ``ErasureCode`` base-class analog.
+
+Default implementations mirroring src/erasure-code/ErasureCode.{h,cc}:
+profile parsing helpers (``to_int``/``to_bool`` — ErasureCode.h:136-152),
+padded data preparation (``encode_prepare`` — ErasureCode.cc), byte-level
+``encode``/``decode`` wrappers over the chunk APIs, chunk remapping, and
+availability-based ``minimum_to_decode``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .interface import ErasureCodeProfile, Flag, SubChunkPlan
+
+# TPU lane width; chunk sizes are padded to a multiple of this so the
+# byte axis tiles cleanly (the SIMD_ALIGN analog, ErasureCode.h).
+CHUNK_ALIGN = 128
+
+
+def to_int(name: str, profile: ErasureCodeProfile, default: int) -> int:
+    v = profile.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise ValueError(f"profile key {name}={v!r} is not an integer")
+
+
+def to_bool(name: str, profile: ErasureCodeProfile, default: bool) -> bool:
+    v = profile.get(name)
+    if v is None or v == "":
+        return default
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+class ErasureCodeBase:
+    """Concrete shared machinery; code families subclass this."""
+
+    def __init__(self) -> None:
+        self.k = 0
+        self.m = 0
+        self.profile: ErasureCodeProfile = {}
+        self.chunk_mapping: list[int] = []
+
+    # -- geometry -----------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_coding_chunk_count(self) -> int:
+        return self.m
+
+    def get_sub_chunk_count(self) -> int:
+        return 1
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """ceil(stripe_width / k) rounded up to CHUNK_ALIGN bytes."""
+        per = -(-stripe_width // self.k)
+        return -(-per // CHUNK_ALIGN) * CHUNK_ALIGN
+
+    def get_flags(self) -> Flag:
+        return Flag.NONE
+
+    def get_chunk_mapping(self) -> list[int]:
+        return self.chunk_mapping or list(range(self.get_chunk_count()))
+
+    # -- planning -----------------------------------------------------
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> SubChunkPlan:
+        """Default: any k available shards, whole chunks.
+
+        Mirrors ErasureCode::_minimum_to_decode — prefer the wanted
+        shards themselves, fill with other survivors up to k.
+        """
+        if want_to_read <= available:
+            return {s: [(0, self.get_sub_chunk_count())] for s in want_to_read}
+        chosen = sorted(want_to_read & available)
+        for s in sorted(available - want_to_read):
+            if len(chosen) >= self.k:
+                break
+            chosen.append(s)
+        if len(chosen) < self.k:
+            raise ValueError(
+                f"cannot decode {sorted(want_to_read)} from "
+                f"{sorted(available)}: need {self.k} shards"
+            )
+        return {s: [(0, self.get_sub_chunk_count())] for s in chosen[: self.k]}
+
+    def minimum_to_decode_with_cost(
+        self, want_to_read: set[int], available: dict[int, int]
+    ) -> set[int]:
+        """Pick the cheapest k-cover (ErasureCodeInterface.h:346): widen
+        a cheapest-first candidate window until a plan exists."""
+        ordered = sorted(available, key=lambda s: (available[s], s))
+        for cut in range(self.k, len(ordered)):
+            try:
+                plan = self.minimum_to_decode(
+                    want_to_read, set(ordered[:cut])
+                )
+                return set(plan)
+            except ValueError:
+                continue
+        return set(self.minimum_to_decode(want_to_read, set(ordered)))
+
+    # -- byte-level wrappers (legacy-interface parity) ----------------
+    def encode_prepare(self, data: bytes) -> jax.Array:
+        """Pad + split a flat byte string into [k, chunk_size] on device.
+
+        The encode() front half of ErasureCode.cc (zero-pad the tail so
+        every chunk is full and aligned — ZERO_PADDING_EXPECTED).
+        """
+        cs = self.get_chunk_size(len(data))
+        buf = np.zeros(self.k * cs, dtype=np.uint8)
+        buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+        return jnp.asarray(buf.reshape(self.k, cs))
+
+    def encode(self, data: bytes) -> dict[int, bytes]:
+        """Whole-object encode returning all k+m chunks as bytes
+        (the legacy encode() contract, ErasureCodeInterface.h:403)."""
+        shards = self.encode_prepare(data)
+        data_map = {i: shards[i] for i in range(self.k)}
+        parity = self.encode_chunks(data_map)
+        out = {}
+        for i in range(self.k):
+            out[i] = bytes(np.asarray(shards[i]))
+        for i, p in parity.items():
+            out[i] = bytes(np.asarray(p))
+        return out
+
+    def decode(
+        self, want_to_read: set[int], chunks: dict[int, bytes]
+    ) -> dict[int, bytes]:
+        """Byte-level decode wrapper (ErasureCodeInterface.h:539)."""
+        arrs = {
+            i: jnp.asarray(np.frombuffer(c, dtype=np.uint8))
+            for i, c in chunks.items()
+        }
+        out = self.decode_chunks(want_to_read, arrs)
+        return {i: bytes(np.asarray(a)) for i, a in out.items()}

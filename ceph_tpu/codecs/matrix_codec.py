@@ -1,0 +1,179 @@
+"""Generic GF(2^8) matrix erasure codec on the bit-plane MXU engine.
+
+The shared engine under every matrix-style family (jerasure
+reed_sol_van/reed_sol_r6_op/cauchy_*, ISA-L RS) — the role
+``jerasure_matrix_encode`` / ``ec_encode_data`` play in the reference,
+re-designed so one jitted dispatch encodes an arbitrary stripe batch.
+
+Decode matrices are computed host-side (tiny <=32x32 inversions) and
+cached in an LRU keyed by the erasure signature — the TableCache
+precedent (isa/ErasureCodeIsaTableCache.cc; SURVEY.md section 7
+"Hard parts").
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.gf import (
+    decode_matrix,
+    gf_matrix_to_bitmatrix,
+)
+from ceph_tpu.ops.bitplane import gf_encode_bitplane, xor_bytes
+
+from .base import ErasureCodeBase
+from .interface import Flag
+
+
+@jax.jit
+def _apply_bitmatrix(bmat: jax.Array, shards: jax.Array) -> jax.Array:
+    return gf_encode_bitplane(bmat, shards)
+
+
+class DecodeTableCache:
+    """LRU of device bit-matrices keyed by (present-shards, wanted-shards).
+
+    The ISA plugin caches inverted decode tables because inversion is the
+    sequential hot-path cost under churny erasure patterns
+    (ErasureCodeIsaTableCache.cc, 327 LoC). Same idea; the cached value
+    here is the expanded GF(2) matrix already on device.
+    """
+
+    def __init__(self, maxsize: int = 256) -> None:
+        self.maxsize = maxsize
+        self._cache: OrderedDict[tuple, jax.Array] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple, build) -> jax.Array:
+        if key in self._cache:
+            self._cache.move_to_end(key)
+            self.hits += 1
+            return self._cache[key]
+        self.misses += 1
+        val = build()
+        self._cache[key] = val
+        if len(self._cache) > self.maxsize:
+            self._cache.popitem(last=False)
+        return val
+
+
+class MatrixErasureCodec(ErasureCodeBase):
+    """Codec defined by a systematic (k+m) x k GF(2^8) generator matrix."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.generator: np.ndarray | None = None  # [(k+m), k] uint8
+        self._encode_bmat: jax.Array | None = None
+        self._tables = DecodeTableCache()
+
+    # Subclasses set self.k/self.m then call this from init().
+    def _set_generator(self, generator: np.ndarray) -> None:
+        self.generator = np.asarray(generator, dtype=np.uint8)
+        assert self.generator.shape == (self.k + self.m, self.k)
+        self._encode_bmat = jnp.asarray(
+            gf_matrix_to_bitmatrix(self.generator[self.k :, :])
+        )
+
+    def get_flags(self) -> Flag:
+        return (
+            Flag.OPTIMIZED_SUPPORTED
+            | Flag.PARITY_DELTA_OPTIMIZATION
+            | Flag.ZERO_INPUT_ZERO_OUTPUT
+            | Flag.ZERO_PADDING_EXPECTED
+            | Flag.PARTIAL_READ_OPTIMIZATION
+            | Flag.PARTIAL_WRITE_OPTIMIZATION
+        )
+
+    # -- encode -------------------------------------------------------
+    def _stack_data(self, data: dict[int, jax.Array]) -> jax.Array:
+        """dict -> [..., k, N]; absent shards are zero (the shared
+        zero-buffer convention of the reference's encode_chunks)."""
+        sample = next(iter(data.values()))
+        shards = [
+            data.get(i, jnp.zeros_like(sample)) for i in range(self.k)
+        ]
+        return jnp.stack(shards, axis=-2)
+
+    def encode_chunks(
+        self, data: dict[int, jax.Array]
+    ) -> dict[int, jax.Array]:
+        stacked = self._stack_data(data)
+        parity = _apply_bitmatrix(self._encode_bmat, stacked)
+        return {
+            self.k + i: parity[..., i, :] for i in range(self.m)
+        }
+
+    # -- decode -------------------------------------------------------
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks: dict[int, jax.Array],
+    ) -> dict[int, jax.Array]:
+        present = sorted(chunks)
+        want = sorted(want_to_read)
+        if all(w in chunks for w in want):
+            return {w: chunks[w] for w in want}
+        key = (tuple(present), tuple(want))
+        bmat = self._tables.get(key, lambda: self._build_decode_bmat(present, want))
+        stacked = jnp.stack([chunks[i] for i in present], axis=-2)
+        out = _apply_bitmatrix(bmat, stacked)
+        result = {}
+        for idx, w in enumerate(want):
+            result[w] = chunks[w] if w in chunks else out[..., idx, :]
+        return result
+
+    def _build_decode_bmat(
+        self, present: list[int], want: list[int]
+    ) -> jax.Array:
+        """Rows producing each wanted shard from the present shards.
+
+        Data shards come from the inverted-submatrix rows; wanted parity
+        shards are re-encoded as G_parity_row @ (decode rows) — the
+        decode-of-data + re-encode-of-parity split of
+        shard_extent_map_t::decode (osd/ECUtil.cc:648-729).
+        """
+        from ceph_tpu.gf import gf_matmul_np
+
+        d = decode_matrix(self.generator, self.k, present)  # [k, len(present)]
+        rows = []
+        for w in want:
+            if w < self.k:
+                rows.append(d[w, :])
+            else:
+                rows.append(gf_matmul_np(self.generator[w : w + 1, :], d)[0])
+        return jnp.asarray(gf_matrix_to_bitmatrix(np.stack(rows)))
+
+    # -- parity delta (RMW) -------------------------------------------
+    def encode_delta(
+        self, old_data: jax.Array, new_data: jax.Array
+    ) -> jax.Array:
+        return xor_bytes(old_data, new_data)
+
+    def apply_delta(
+        self,
+        delta: dict[int, jax.Array],
+        parity: dict[int, jax.Array],
+    ) -> dict[int, jax.Array]:
+        """parity'_j = parity_j XOR sum_i G[j, i] * delta_i.
+
+        The matrix_apply_delta analog (ErasureCodeJerasure.h:110-119):
+        one small matmul over just the changed columns.
+        """
+        cols = sorted(delta)
+        bmat = self._tables.get(
+            ("delta", tuple(cols)),
+            lambda: jnp.asarray(
+                gf_matrix_to_bitmatrix(self.generator[self.k :, cols])
+            ),
+        )
+        stacked = jnp.stack([delta[c] for c in cols], axis=-2)
+        contrib = _apply_bitmatrix(bmat, stacked)
+        return {
+            pid: xor_bytes(p, contrib[..., pid - self.k, :])
+            for pid, p in parity.items()
+        }

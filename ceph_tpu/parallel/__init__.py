@@ -1,0 +1,7 @@
+"""Multi-chip shard fan-out over a jax.sharding.Mesh."""
+
+from .mesh import (  # noqa: F401
+    make_ec_mesh,
+    sharded_encode,
+    sharded_pipeline_step,
+)
