@@ -1,0 +1,193 @@
+"""CRC32C as a batched GF(2) matrix fold on the MXU.
+
+The reference dispatches to per-arch carryless-multiply kernels
+(src/common/crc32c.cc:19-32, src/arch/intel.c). TPUs have no clmul, so
+we use linearity instead (SURVEY.md §7 "Hard parts"): with the
+reflected Castagnoli polynomial, the CRC register after a message is
+
+    crc(init, msg) = A_L @ init  ⊕  Σ_i  K_i @ bits(chunk_i)
+
+over GF(2), where A_L is the 32x32 zero-message transition for L bytes
+and K_i folds chunk i's bits directly to its final-position remainder
+contribution. All K_i stack into one [S, 32, c*8] tensor, so a whole
+batch of blocks is ONE int8 einsum with int32 accumulation (exact:
+fan-in ≤ S*c*8 < 2^31) followed by ``& 1`` — the same mod-2 MXU
+discipline as the EC engine (ceph_tpu.ops.bitplane).
+
+Bit convention is LSB-first everywhere (bit b of byte j sits at index
+j*8+b), matching the reflected-CRC register order so no bit reversal
+is ever materialised.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .reference import CRC32C_POLY_REFLECTED, crc32c_ref
+
+CHUNK_BYTES = 64  # fold granularity; 512-bit MXU contraction per chunk
+
+
+def _bits32(v: int) -> np.ndarray:
+    return np.array([(v >> i) & 1 for i in range(32)], dtype=np.uint8)
+
+
+def _pack32(bits: np.ndarray) -> int:
+    return int(sum(int(b) << i for i, b in enumerate(bits)))
+
+
+@functools.lru_cache(maxsize=None)
+def byte_step_matrix() -> bytes:
+    """32x32 GF(2) matrix M: register transition for one ZERO byte.
+
+    Column j = register after feeding one zero byte starting from the
+    unit register e_j (the transition is linear, so unit responses
+    define it).
+    """
+    m = np.zeros((32, 32), dtype=np.uint8)
+    for j in range(32):
+        m[:, j] = _bits32(crc32c_ref(1 << j, b"\x00"))
+    return m.tobytes()
+
+
+def _mat(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, dtype=np.uint8).reshape(32, 32)
+
+
+@functools.lru_cache(maxsize=None)
+def zero_gap_matrix(nbytes: int) -> bytes:
+    """A_n = M^n: transition across n zero bytes (square-and-multiply)."""
+    result = np.eye(32, dtype=np.uint8)
+    base = _mat(byte_step_matrix())
+    n = nbytes
+    while n:
+        if n & 1:
+            result = (result @ base) & 1
+        base = (base @ base) & 1
+        n >>= 1
+    return result.astype(np.uint8).tobytes()
+
+
+@functools.lru_cache(maxsize=None)
+def chunk_fold_matrix(c: int = CHUNK_BYTES) -> bytes:
+    """B_c [32, c*8]: remainder of a c-byte chunk from zero init.
+
+    Column j*8+b = crc register after the chunk whose only set bit is
+    bit b of byte j. Built from unit responses once per chunk size.
+    """
+    out = np.zeros((32, c * 8), dtype=np.uint8)
+    for j in range(c):
+        for b in range(8):
+            msg = bytearray(c)
+            msg[j] = 1 << b
+            out[:, j * 8 + b] = _bits32(crc32c_ref(0, bytes(msg)))
+    return out.tobytes()
+
+
+@functools.lru_cache(maxsize=None)
+def fold_tensor(block_bytes: int, c: int = CHUNK_BYTES) -> np.ndarray:
+    """K [S, 32, c*8] with K_i = A_{(S-1-i)*c} @ B_c. One-time per
+    (block size, chunk size); the TableCache discipline again."""
+    assert block_bytes % c == 0, (block_bytes, c)
+    s = block_bytes // c
+    bc = np.frombuffer(chunk_fold_matrix(c), dtype=np.uint8).reshape(32, c * 8)
+    k = np.empty((s, 32, c * 8), dtype=np.uint8)
+    for i in range(s):
+        a = _mat(zero_gap_matrix((s - 1 - i) * c))
+        k[i] = (a @ bc) & 1
+    return k
+
+
+def _pick_chunk(block_bytes: int) -> int:
+    c = CHUNK_BYTES
+    while block_bytes % c:
+        c >>= 1
+    return c
+
+
+@functools.lru_cache(maxsize=None)
+def _device_fold(block_bytes: int, c: int):
+    """Device-resident (K, A_total) — uploaded once per block size, not
+    per call (re-upload measured 10x+ slower through the device tunnel)."""
+    k_fold = jnp.asarray(fold_tensor(block_bytes, c), dtype=jnp.int8)
+    a_total = jnp.asarray(_mat(zero_gap_matrix(block_bytes)), dtype=jnp.int8)
+    return k_fold, a_total
+
+
+@functools.partial(jax.jit, static_argnames=("block_bytes",))
+def _crc32c_kernel(
+    data: jax.Array,  # [B, L] uint8
+    init: jax.Array,  # scalar uint32
+    k_fold: jax.Array,  # [S, 32, c*8] int8
+    a_total: jax.Array,  # [32, 32] int8
+    *,
+    block_bytes: int,
+) -> jax.Array:
+    c8 = k_fold.shape[-1]
+    s = k_fold.shape[0]
+    chunks = data.reshape(data.shape[0], s, c8 // 8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((chunks[..., None] >> shifts) & jnp.uint8(1)).reshape(
+        data.shape[0], s, c8
+    )
+    acc = jnp.einsum(
+        "src,bsc->br",
+        k_fold,
+        bits.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
+    init_bits = ((init >> jnp.arange(32, dtype=jnp.uint32)) & 1).astype(
+        jnp.int8
+    )
+    acc = acc + (a_total.astype(jnp.int32) @ init_bits.astype(jnp.int32))
+    crc_bits = (acc & 1).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(crc_bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def crc32c_device(
+    data: jax.Array, init: int | jax.Array = 0xFFFFFFFF
+) -> jax.Array:
+    """Per-block CRC32C of ``data`` [..., block_bytes] -> [...] uint32.
+
+    Device analog of ``ceph_crc32c(init, block, len)`` vmapped over
+    blocks; used by deep scrub and the ProtocolV2-analog segment
+    checksums.
+    """
+    block_bytes = int(data.shape[-1])
+    lead = data.shape[:-1]
+    flat = data.reshape(-1, block_bytes)
+    c = _pick_chunk(block_bytes)
+    k_fold, a_total = _device_fold(block_bytes, c)
+    out = _crc32c_kernel(
+        flat,
+        jnp.asarray(init, dtype=jnp.uint32),
+        k_fold,
+        a_total,
+        block_bytes=block_bytes,
+    )
+    return out.reshape(lead)
+
+
+def crc32c(init: int, data: bytes) -> int:
+    """Host scalar API mirroring ``ceph_crc32c`` exactly — including the
+    crc-of-zeros fast path the reference gets from crc32c_null
+    (common/crc32c.h): runs the matrix transition, no byte loop."""
+    if not data:
+        return init & 0xFFFFFFFF
+    if not any(data):
+        a = _mat(zero_gap_matrix(len(data)))
+        return _pack32((a @ _bits32(init)) & 1)
+    return crc32c_ref(init, data)
+
+
+def crc32c_concat(crc_a: int, crc_b_zero_init: int, len_b: int) -> int:
+    """crc(A||B) from crc(A) and crc(B with zero init) — the bufferlist
+    cached-crc "range concatenation" trick (common/crc32c.h,
+    buffer.cc): crc(A||B) = A_{len_b} @ crc(A) ⊕ crc_0(B)."""
+    a = _mat(zero_gap_matrix(len_b))
+    return _pack32((a @ _bits32(crc_a)) & 1) ^ crc_b_zero_init

@@ -1,0 +1,94 @@
+"""uint64 arithmetic as uint32 pairs — xxhash64 lanes without x64 mode.
+
+JAX runs with 32-bit ints here (x64 would globally change dtypes and
+TPUs emulate 64-bit anyway), so xxh64's multiplies/rotates operate on
+``(hi, lo)`` uint32 array pairs. Multiplication builds the low 64 bits
+from 16-bit limb products (each partial < 2^32, so uint32 wrap-around
+arithmetic with explicit carries is exact).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U64 = tuple  # (hi, lo) uint32 arrays
+
+_MASK16 = jnp.uint32(0xFFFF)
+
+
+def u64(hi, lo) -> U64:
+    return (jnp.asarray(hi, jnp.uint32), jnp.asarray(lo, jnp.uint32))
+
+
+def from_const(v: int) -> U64:
+    return (
+        jnp.uint32((v >> 32) & 0xFFFFFFFF),
+        jnp.uint32(v & 0xFFFFFFFF),
+    )
+
+
+def add(a: U64, b: U64) -> U64:
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def xor(a: U64, b: U64) -> U64:
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _mul32_full(a, b):
+    """Full 64-bit product of two uint32 arrays -> (hi, lo) uint32."""
+    al, ah = a & _MASK16, a >> 16
+    bl, bh = b & _MASK16, b >> 16
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    # lo = ll + ((lh + hl) << 16), carries tracked limb-wise.
+    mid = lh + (hl & _MASK16)  # fits: < 2^32 + 2^16... track carefully
+    mid_carry = (mid < lh).astype(jnp.uint32)
+    lo = ll + (mid << 16)
+    lo_carry = (lo < ll).astype(jnp.uint32)
+    hi = hh + (hl >> 16) + (mid >> 16) + (mid_carry << 16) + lo_carry
+    return (hi, lo)
+
+
+def mul(a: U64, b: U64) -> U64:
+    """Low 64 bits of a*b (wrap-around, as uint64 multiply does)."""
+    hi, lo = _mul32_full(a[1], b[1])
+    hi = hi + a[1] * b[0] + a[0] * b[1]
+    return (hi, lo)
+
+
+def rotl(a: U64, r: int) -> U64:
+    r &= 63
+    if r == 0:
+        return a
+    if r == 32:
+        return (a[1], a[0])
+    if r < 32:
+        hi = (a[0] << r) | (a[1] >> (32 - r))
+        lo = (a[1] << r) | (a[0] >> (32 - r))
+        return (hi, lo)
+    s = r - 32
+    hi = (a[1] << s) | (a[0] >> (32 - s))
+    lo = (a[0] << s) | (a[1] >> (32 - s))
+    return (hi, lo)
+
+
+def shr(a: U64, r: int) -> U64:
+    r &= 63
+    if r == 0:
+        return a
+    if r == 32:
+        return (jnp.zeros_like(a[0]), a[0])
+    if r < 32:
+        lo = (a[1] >> r) | (a[0] << (32 - r))
+        return (a[0] >> r, lo)
+    return (jnp.zeros_like(a[0]), a[0] >> (r - 32))
+
+
+def to_py(a: U64) -> int:
+    """Scalar (hi, lo) -> python int (for tests/digest extraction)."""
+    return (int(a[0]) << 32) | int(a[1])

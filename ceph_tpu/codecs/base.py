@@ -109,6 +109,16 @@ class ErasureCodeBase:
                 continue
         return set(self.minimum_to_decode(want_to_read, set(ordered)))
 
+    # -- shared shard plumbing ----------------------------------------
+    def _stack_data(self, data: dict[int, jax.Array]) -> jax.Array:
+        """dict -> [..., k, N]; absent shards are zero (the shared
+        zero-buffer convention of the reference's encode_chunks)."""
+        sample = next(iter(data.values()))
+        shards = [
+            data.get(i, jnp.zeros_like(sample)) for i in range(self.k)
+        ]
+        return jnp.stack(shards, axis=-2)
+
     # -- byte-level wrappers (legacy-interface parity) ----------------
     def encode_prepare(self, data: bytes) -> jax.Array:
         """Pad + split a flat byte string into [k, chunk_size] on device.

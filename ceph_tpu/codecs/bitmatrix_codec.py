@@ -201,11 +201,7 @@ class BitMatrixCodec(ErasureCodeBase):
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
-        sample = next(iter(data.values()))
-        stacked = jnp.stack(
-            [data.get(i, jnp.zeros_like(sample)) for i in range(self.k)],
-            axis=-2,
-        )
+        stacked = self._stack_data(data)
         parity = self._to_chunks(
             _apply_packets(self._device_bmat, self._to_packets(stacked))
         )
@@ -217,9 +213,9 @@ class BitMatrixCodec(ErasureCodeBase):
         chunks: dict[int, jax.Array],
     ) -> dict[int, jax.Array]:
         present = sorted(chunks)
-        want = sorted(want_to_read)
-        if all(w in chunks for w in want):
-            return {w: chunks[w] for w in want}
+        want = sorted(w for w in want_to_read if w not in chunks)
+        if not want:
+            return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
         bmat = self._tables.get(
             key, lambda: self._build_decode_bitmatrix(present, want)
@@ -228,11 +224,9 @@ class BitMatrixCodec(ErasureCodeBase):
         out = self._to_chunks(
             _apply_packets(bmat, self._to_packets(stacked))
         )
-        result = {}
+        result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, wshard in enumerate(want):
-            result[wshard] = (
-                chunks[wshard] if wshard in chunks else out[..., idx, :]
-            )
+            result[wshard] = out[..., idx, :]
         return result
 
     def _build_decode_bitmatrix(

@@ -90,15 +90,6 @@ class MatrixErasureCodec(ErasureCodeBase):
         )
 
     # -- encode -------------------------------------------------------
-    def _stack_data(self, data: dict[int, jax.Array]) -> jax.Array:
-        """dict -> [..., k, N]; absent shards are zero (the shared
-        zero-buffer convention of the reference's encode_chunks)."""
-        sample = next(iter(data.values()))
-        shards = [
-            data.get(i, jnp.zeros_like(sample)) for i in range(self.k)
-        ]
-        return jnp.stack(shards, axis=-2)
-
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
@@ -115,16 +106,19 @@ class MatrixErasureCodec(ErasureCodeBase):
         chunks: dict[int, jax.Array],
     ) -> dict[int, jax.Array]:
         present = sorted(chunks)
-        want = sorted(want_to_read)
-        if all(w in chunks for w in want):
-            return {w: chunks[w] for w in want}
+        # Only reconstruct what is actually missing: wanted-but-present
+        # shards pass through, keeping decode tables (and the LRU keys)
+        # erasure-pattern-minimal.
+        want = sorted(w for w in want_to_read if w not in chunks)
+        if not want:
+            return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
         bmat = self._tables.get(key, lambda: self._build_decode_bmat(present, want))
         stacked = jnp.stack([chunks[i] for i in present], axis=-2)
         out = _apply_bitmatrix(bmat, stacked)
-        result = {}
+        result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, w in enumerate(want):
-            result[w] = chunks[w] if w in chunks else out[..., idx, :]
+            result[w] = out[..., idx, :]
         return result
 
     def _build_decode_bmat(

@@ -33,8 +33,14 @@ from ceph_tpu.ops.bitplane import pack_bits, unpack_bits
 def make_ec_mesh(n_devices: int | None = None, k: int = 8) -> Mesh:
     """Mesh over (dp, sp): sp divides both n_devices and k so the shard
     axis splits evenly; prefer using both axes when possible."""
-    devs = jax.devices()[: n_devices or len(jax.devices())]
-    n = len(devs)
+    avail = jax.devices()
+    n = n_devices or len(avail)
+    if n > len(avail):
+        raise ValueError(
+            f"requested {n} devices but only {len(avail)} available; "
+            "a degenerate mesh would silently skip the collective path"
+        )
+    devs = avail[:n]
     # sp must divide BOTH n (for the reshape) and k (for even shard
     # split); prefer the largest such sp that still leaves dp > 1 so
     # both axes are exercised, else fall back to sp = gcd(n, k).
