@@ -1,0 +1,20 @@
+"""Stripe pipeline: the OSD EC data-path semantics over batched TPU dispatch.
+
+Mirrors the role of the reference's osd/EC* stack (SURVEY.md section 2.2):
+``stripe`` is the ECUtil analog (geometry + shard extent maps + HashInfo),
+``transaction`` the ECTransaction analog (write planning), ``cache`` the
+ECExtentCache analog, ``rmw``/``read`` the ECCommon pipelines, ``store``
+the MemStore-style shard store, and ``recovery`` the backfill FSM.
+"""
+
+from .extents import ExtentSet
+from .hashinfo import HashInfo
+from .stripe import StripeInfo
+from .shard_map import ShardExtentMap
+
+__all__ = [
+    "ExtentSet",
+    "HashInfo",
+    "StripeInfo",
+    "ShardExtentMap",
+]

@@ -1,0 +1,92 @@
+"""Per-shard cumulative CRC32C — the ``ECUtil::HashInfo`` analog.
+
+Mirrors osd/ECUtil.h:731-780: one cumulative crc32c per shard, seeded
+at -1 (0xFFFFFFFF), updated append-only as shards grow; persisted next
+to the object and checked by deep scrub (ECBackend.cc:1829-1869).
+
+The crc math itself rides the Checksummer family; appends batch through
+the device CRC kernel when large, host fallback when tiny.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ceph_tpu.checksum.reference import crc32c_ref
+
+SEED = 0xFFFFFFFF
+
+
+class HashInfo:
+    def __init__(self, num_chunks: int) -> None:
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [SEED] * num_chunks
+
+    def append(self, old_size: int, to_append: dict[int, np.ndarray]) -> None:
+        """Extend shard crcs with bytes written at ``old_size``.
+
+        The reference asserts appends are contiguous and equal-length
+        across shards (HashInfo::append, ECUtil.cc); same contract here.
+        """
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"non-contiguous append: old_size={old_size}, "
+                f"have={self.total_chunk_size}"
+            )
+        sizes = {int(np.asarray(b).size) for b in to_append.values()}
+        if len(sizes) > 1:
+            raise ValueError(f"unequal append sizes {sizes}")
+        for shard, buf in to_append.items():
+            data = bytes(np.asarray(buf, dtype=np.uint8))
+            self.cumulative_shard_hashes[shard] = crc32c_ref(
+                self.cumulative_shard_hashes[shard], data
+            )
+        if sizes:
+            self.total_chunk_size += sizes.pop()
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def get_total_chunk_size(self) -> int:
+        return self.total_chunk_size
+
+    def has_chunk_hash(self) -> bool:
+        return bool(self.cumulative_shard_hashes)
+
+    def clear(self) -> None:
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [
+            SEED for _ in self.cumulative_shard_hashes
+        ]
+
+    # -- persistence (the encode/decode-to-attr analog) ----------------
+    def to_bytes(self) -> bytes:
+        return json.dumps(
+            {
+                "total_chunk_size": self.total_chunk_size,
+                "hashes": self.cumulative_shard_hashes,
+            }
+        ).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "HashInfo":
+        obj = json.loads(raw.decode())
+        hi = cls(len(obj["hashes"]))
+        hi.total_chunk_size = obj["total_chunk_size"]
+        hi.cumulative_shard_hashes = list(obj["hashes"])
+        return hi
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, HashInfo)
+            and self.total_chunk_size == other.total_chunk_size
+            and self.cumulative_shard_hashes == other.cumulative_shard_hashes
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"HashInfo(size={self.total_chunk_size}, "
+            f"crcs={[hex(h) for h in self.cumulative_shard_hashes]})"
+        )

@@ -7,15 +7,21 @@ is the hardware-free cluster.
 
 import os
 
-# Force CPU even though the shell exports JAX_PLATFORMS=axon (the real
-# TPU tunnel): unit tests must be hardware-free and fast; per-call sync
-# latency through the tunnel makes them hang otherwise.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Force CPU even though the environment wires JAX to the real TPU
+# tunnel (axon): unit tests must be hardware-free and fast. The axon
+# sitecustomize hook sets the *config* key jax_platforms="axon", which
+# beats the JAX_PLATFORMS env var — so override at the config level,
+# before any backend initializes (conftest imports run pre-init).
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

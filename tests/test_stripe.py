@@ -1,0 +1,254 @@
+"""Stripe-layer tests: geometry arithmetic, extent sets, shard extent
+map encode/decode/parity-delta, HashInfo — the TestECUtil.cc analog
+(reference src/test/osd/TestECUtil.cc)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.pipeline import ExtentSet, HashInfo, ShardExtentMap, StripeInfo
+from ceph_tpu.pipeline.stripe import PAGE_SIZE
+
+
+# ---------------------------------------------------------------- extents
+class TestExtentSet:
+    def test_insert_coalesce(self):
+        es = ExtentSet()
+        es.insert(0, 10)
+        es.insert(20, 10)
+        assert list(es) == [(0, 10), (20, 30)]
+        es.insert(10, 10)  # bridges the gap
+        assert list(es) == [(0, 30)]
+
+    def test_overlap_and_contains(self):
+        es = ExtentSet([(0, 100), (200, 300)])
+        assert es.contains(50, 10)
+        assert not es.contains(150, 10)
+        assert not es.contains(95, 10)
+        assert es.intersects(95, 200)
+        assert not es.intersects(100, 100)
+
+    def test_erase(self):
+        es = ExtentSet([(0, 100)])
+        es.erase(40, 20)
+        assert list(es) == [(0, 40), (60, 100)]
+
+    def test_set_ops(self):
+        a = ExtentSet([(0, 50), (100, 150)])
+        b = ExtentSet([(25, 125)])
+        assert list(a.intersection(b)) == [(25, 50), (100, 125)]
+        assert list(a.difference(b)) == [(0, 25), (125, 150)]
+
+    def test_align(self):
+        es = ExtentSet([(5, 10)])
+        assert list(es.align(8)) == [(0, 16)]
+
+    def test_size(self):
+        assert ExtentSet([(0, 10), (20, 25)]).size() == 15
+
+
+# ---------------------------------------------------------------- geometry
+class TestStripeInfo:
+    def test_basic(self):
+        si = StripeInfo(4, 2, 4 * 4096)
+        assert si.chunk_size == 4096
+        assert si.data_shards == frozenset(range(4))
+        assert si.parity_shards == frozenset([4, 5])
+
+    def test_bad_width(self):
+        with pytest.raises(ValueError):
+            StripeInfo(4, 2, 4097)
+
+    def test_chunk_mapping_permutation(self):
+        si = StripeInfo(2, 1, 8192, chunk_mapping=[2, 0, 1])
+        assert si.get_shard(0) == 2
+        assert si.get_raw_shard(2) == 0
+        assert si.data_shards == frozenset([2, 0])
+        assert si.parity_shards == frozenset([1])
+        with pytest.raises(ValueError):
+            StripeInfo(2, 1, 8192, chunk_mapping=[0, 0, 1])
+
+    def test_ro_offset_to_shard_offset(self):
+        # k=4, chunk=4096: ro offset 5000 lives in chunk 1 (raw shard 1).
+        si = StripeInfo(4, 2, 4 * 4096)
+        assert si.ro_offset_to_shard_offset(5000, 1) == 5000 - 4096
+        assert si.ro_offset_to_shard_offset(5000, 0) == 4096  # full chunk
+        assert si.ro_offset_to_shard_offset(5000, 2) == 0     # untouched
+        # Second stripe: ro 16384+100 -> raw shard 0, offset 4096+100.
+        assert si.ro_offset_to_shard_offset(16484, 0) == 4196
+
+    def test_object_size_to_shard_size(self):
+        si = StripeInfo(4, 2, 4 * 4096)
+        # 1.5 stripes: shards 0,1 hold 2 chunks, shards 2,3 hold 1.
+        size = 6 * 4096
+        assert si.object_size_to_shard_size(size, 0) == 2 * 4096
+        assert si.object_size_to_shard_size(size, 1) == 2 * 4096
+        assert si.object_size_to_shard_size(size, 2) == 1 * 4096
+        assert si.object_size_to_shard_size(size, 3) == 1 * 4096
+        # Parity matches data shard 0.
+        assert si.object_size_to_shard_size(size, 4) == 2 * 4096
+        # Page alignment on a ragged tail.
+        assert si.object_size_to_shard_size(100, 0) == PAGE_SIZE
+        assert si.object_size_to_shard_size(100, 1) == 0
+
+    def test_stripe_rounding(self):
+        si = StripeInfo(4, 2, 4 * 4096)
+        assert si.ro_offset_to_prev_stripe_ro_offset(20000) == 16384
+        assert si.ro_offset_to_next_stripe_ro_offset(20000) == 32768
+        assert si.ro_offset_to_next_stripe_ro_offset(16384) == 16384
+
+    def test_ro_range_to_shard_extent_set(self):
+        si = StripeInfo(4, 2, 4 * 4096)
+        # One byte in each of the first two chunks.
+        out = si.ro_range_to_shard_extent_set(4095, 2)
+        assert list(out[0]) == [(4095, 4096)]
+        assert list(out[1]) == [(0, 1)]
+        # Full stripe + 1 byte wraps to shard 0's second chunk.
+        out = si.ro_range_to_shard_extent_set(0, 16385)
+        assert list(out[0]) == [(0, 4097)]
+        assert list(out[3]) == [(0, 4096)]
+
+    def test_ro_range_parity_hull(self):
+        si = StripeInfo(4, 2, 4 * 4096)
+        out = si.ro_range_to_shard_extent_set(100, 50, parity=True)
+        assert list(out[4]) == [(0, 4096)]
+        assert list(out[5]) == [(0, 4096)]
+
+
+# ---------------------------------------------------------------- hashinfo
+class TestHashInfo:
+    def test_append_and_roundtrip(self, rng):
+        hi = HashInfo(3)
+        bufs = {i: rng.integers(0, 256, 4096, dtype=np.uint8) for i in range(3)}
+        hi.append(0, bufs)
+        assert hi.get_total_chunk_size() == 4096
+        one_shot = HashInfo(3)
+        one_shot.append(0, bufs)
+        assert hi == one_shot
+        # Cumulative: appending in two halves == one shot over the concat.
+        two_step = HashInfo(3)
+        two_step.append(0, {i: b[:2048] for i, b in bufs.items()})
+        two_step.append(2048, {i: b[2048:] for i, b in bufs.items()})
+        assert two_step == hi
+        assert HashInfo.from_bytes(hi.to_bytes()) == hi
+
+    def test_append_contract(self, rng):
+        hi = HashInfo(2)
+        with pytest.raises(ValueError):
+            hi.append(100, {0: np.zeros(10, np.uint8)})
+        with pytest.raises(ValueError):
+            hi.append(
+                0,
+                {0: np.zeros(10, np.uint8), 1: np.zeros(20, np.uint8)},
+            )
+
+
+# ---------------------------------------------------------------- shard map
+@pytest.fixture
+def codec():
+    c = registry.factory("jerasure", {"k": "4", "m": "2",
+                                      "technique": "reed_sol_van"})
+    return c
+
+
+@pytest.fixture
+def sinfo():
+    return StripeInfo(4, 2, 4 * 4096)
+
+
+class TestShardExtentMap:
+    def test_insert_get_zero_fill(self, sinfo):
+        sem = ShardExtentMap(sinfo)
+        sem.insert(0, 100, b"\x07" * 50)
+        got = sem.get(0, 90, 70)
+        assert (got[:10] == 0).all()
+        assert (got[10:60] == 7).all()
+        assert (got[60:] == 0).all()
+
+    def test_insert_coalesce_overlap(self, sinfo):
+        sem = ShardExtentMap(sinfo)
+        sem.insert(0, 0, b"\x01" * 100)
+        sem.insert(0, 50, b"\x02" * 100)  # later insert wins on overlap
+        assert list(sem.get_extent_set(0)) == [(0, 150)]
+        got = sem.get(0, 0, 150)
+        assert (got[:50] == 1).all() and (got[50:] == 2).all()
+
+    def test_pad_to_page_align(self, sinfo):
+        sem = ShardExtentMap(sinfo)
+        sem.insert(1, 5000, b"\xff" * 100)
+        sem.pad_and_rebuild_to_page_align()
+        assert list(sem.get_extent_set(1)) == [(4096, 8192)]
+        got = sem.get(1, 4096, 4096)
+        assert got.sum() == 100 * 0xFF
+
+    def test_encode_decode_roundtrip(self, sinfo, codec, rng):
+        data = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
+        sem = ShardExtentMap(sinfo)
+        for i in range(4):
+            sem.insert(i, 0, data[i])
+        sem.encode(codec)
+        assert sorted(sem.shards()) == [0, 1, 2, 3, 4, 5]
+
+        # Drop two shards, rebuild via decode.
+        lost = [1, 4]
+        survivor = ShardExtentMap(sinfo)
+        for s in sem.shards():
+            if s not in lost:
+                survivor.insert(s, 0, sem.get(s, 0, 4096))
+        survivor.decode(codec, set(lost), object_size=4 * 4096)
+        for s in lost:
+            assert (survivor.get(s, 0, 4096) == sem.get(s, 0, 4096)).all()
+
+    def test_parity_delta_matches_full_encode(self, sinfo, codec, rng):
+        old_data = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
+        old_map = ShardExtentMap(sinfo)
+        for i in range(4):
+            old_map.insert(i, 0, old_data[i])
+        old_map.encode(codec)
+
+        # Overwrite shard 2 only, via parity delta.
+        new_chunk = rng.integers(0, 256, 4096, dtype=np.uint8)
+        delta_map = ShardExtentMap(sinfo)
+        delta_map.insert(2, 0, new_chunk)
+        delta_map.encode_parity_delta(codec, old_map)
+
+        # Reference: full re-encode with the new data.
+        full = ShardExtentMap(sinfo)
+        for i in range(4):
+            full.insert(i, 0, new_chunk if i == 2 else old_data[i])
+        full.encode(codec)
+        for p in (4, 5):
+            assert (
+                delta_map.get(p, 0, 4096) == full.get(p, 0, 4096)
+            ).all()
+
+    def test_encode_updates_hashinfo(self, sinfo, codec, rng):
+        data = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
+        sem = ShardExtentMap(sinfo)
+        for i in range(4):
+            sem.insert(i, 0, data[i])
+        hi = HashInfo(6)
+        sem.encode(codec, hashinfo=hi, old_size=0)
+        assert hi.get_total_chunk_size() == 4096
+        from ceph_tpu.checksum.reference import crc32c_ref
+
+        for s in range(6):
+            expect = crc32c_ref(0xFFFFFFFF, bytes(sem.get(s, 0, 4096)))
+            assert hi.get_chunk_hash(s) == expect
+
+    def test_decode_with_chunk_mapping(self, codec, rng):
+        # Permuted stored layout must still round-trip.
+        si = StripeInfo(4, 2, 4 * 4096, chunk_mapping=[5, 0, 1, 2, 3, 4])
+        data = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
+        sem = ShardExtentMap(si)
+        for raw in range(4):
+            sem.insert(si.get_shard(raw), 0, data[raw])
+        sem.encode(codec)
+        assert sorted(sem.shards()) == [0, 1, 2, 3, 4, 5]
+        lost = si.get_shard(0)  # stored shard holding raw 0
+        survivor = ShardExtentMap(si)
+        for s in sem.shards():
+            if s != lost:
+                survivor.insert(s, 0, sem.get(s, 0, 4096))
+        survivor.decode(codec, {lost}, object_size=4 * 4096)
+        assert (survivor.get(lost, 0, 4096) == data[0]).all()
