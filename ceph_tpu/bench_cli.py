@@ -58,6 +58,9 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
 
 def main(argv: list[str] | None = None) -> int:
     args = parse_args(argv)
+    from ceph_tpu.utils import honor_platform_env
+
+    honor_platform_env()
     import jax
     import jax.numpy as jnp
 

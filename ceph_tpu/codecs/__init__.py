@@ -29,3 +29,5 @@ from .registry import (  # noqa: F401
 # preload — global.yaml.in:2638).
 from . import jerasure as _jerasure  # noqa: E402,F401
 from . import isa as _isa  # noqa: E402,F401
+from . import lrc as _lrc  # noqa: E402,F401
+from . import shec as _shec  # noqa: E402,F401

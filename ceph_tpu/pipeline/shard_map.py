@@ -160,16 +160,20 @@ class ShardExtentMap:
         for j in range(m):
             self.insert(self.sinfo.get_shard(k + j), lo, parity[j])
         if hashinfo is not None:
+            # Appends must be contiguous and equal-length across shards
+            # (the HashInfo contract): hash every shard's zero-padded
+            # tail up to the common window end.
             base = lo if old_size is None else old_size
-            to_append = {}
-            for raw in range(k + m):
-                shard = self.sinfo.get_shard(raw)
-                es = self.get_extent_set(shard)
-                if es and es.range_end() > base:
-                    to_append[shard] = self.get(
-                        shard, base, es.range_end() - base
-                    )
-            hashinfo.append(base, to_append)
+            if hi > base:
+                hashinfo.append(
+                    base,
+                    {
+                        self.sinfo.get_shard(raw): self.get(
+                            self.sinfo.get_shard(raw), base, hi - base
+                        )
+                        for raw in range(k + m)
+                    },
+                )
 
     @staticmethod
     def _dispatch_encode(codec, data: np.ndarray) -> np.ndarray:
@@ -200,8 +204,17 @@ class ShardExtentMap:
             shard = self.sinfo.get_shard(raw)
             if shard not in self._bufs:
                 continue
-            new = self.get(shard, lo, hi - lo)
+            # Only bytes this map actually wrote may differ: fill the
+            # rest of the window from old so delta is zero there (a
+            # zero-filled gap would otherwise XOR the old data OUT of
+            # the parity — silent corruption).
             old = old_map.get(shard, lo, hi - lo)
+            new = old.copy()
+            for off, end in self.get_extent_set(shard):
+                s = max(off, lo)
+                e = min(end, hi)
+                if s < e:
+                    new[s - lo : e - lo] = self.get(shard, s, e - s)
             deltas[raw] = jnp.asarray(
                 np.asarray(
                     codec.encode_delta(jnp.asarray(old), jnp.asarray(new))

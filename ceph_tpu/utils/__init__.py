@@ -1,0 +1,6 @@
+"""Runtime utilities: platform selection, perf counters, config,
+tracing — the ``src/common/`` analog layer."""
+
+from .platform import honor_platform_env
+
+__all__ = ["honor_platform_env"]

@@ -1,0 +1,26 @@
+"""Backend selection helpers.
+
+Environments that tunnel JAX to remote accelerators (the axon site
+hook) set the ``jax_platforms`` *config* key at interpreter start,
+which silently outranks the ``JAX_PLATFORMS`` env var. Tools that are
+explicitly asked for a platform (unit tests, the driver's virtual-mesh
+dry run, CPU benches) call :func:`honor_platform_env` before first
+device use so the config agrees with the env.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Make jax_platforms config match an explicit JAX_PLATFORMS=cpu.
+
+    No-op when the env var is unset or requests non-CPU platforms —
+    the default (tunnel/TPU) path stays untouched.
+    """
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in want.split(","):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -222,6 +222,54 @@ class TestShardExtentMap:
                 delta_map.get(p, 0, 4096) == full.get(p, 0, 4096)
             ).all()
 
+    def test_parity_delta_partial_extents(self, sinfo, codec, rng):
+        """Regression: an RMW map whose shards cover different windows
+        must not treat unwritten bytes as zero (that would XOR the old
+        data out of the parity)."""
+        old_data = rng.integers(0, 256, (4, 8192), dtype=np.uint8)
+        old_map = ShardExtentMap(sinfo)
+        for i in range(4):
+            old_map.insert(i, 0, old_data[i])
+        old_map.encode(codec)
+
+        # New write touches shard 0 at [0,4096) and shard 1 at
+        # [4096,8192) — disjoint windows inside an [0,8192) hull.
+        n0 = rng.integers(0, 256, 4096, dtype=np.uint8)
+        n1 = rng.integers(0, 256, 4096, dtype=np.uint8)
+        delta_map = ShardExtentMap(sinfo)
+        delta_map.insert(0, 0, n0)
+        delta_map.insert(1, 4096, n1)
+        delta_map.encode_parity_delta(codec, old_map)
+
+        full = ShardExtentMap(sinfo)
+        for i in range(4):
+            buf = old_data[i].copy()
+            if i == 0:
+                buf[:4096] = n0
+            if i == 1:
+                buf[4096:] = n1
+            full.insert(i, 0, buf)
+        full.encode(codec)
+        for p in (4, 5):
+            assert (
+                delta_map.get(p, 0, 8192) == full.get(p, 0, 8192)
+            ).all()
+
+    def test_encode_hashinfo_ragged_tail(self, sinfo, codec, rng):
+        """Regression: a non-stripe-multiple object must hash equal
+        zero-padded tails, not crash on unequal append sizes."""
+        sem = ShardExtentMap(sinfo)
+        sem.insert(0, 0, rng.integers(0, 256, 8192, dtype=np.uint8))
+        sem.insert(1, 0, rng.integers(0, 256, 4096, dtype=np.uint8))
+        hi = HashInfo(6)
+        sem.encode(codec, hashinfo=hi, old_size=0)
+        assert hi.get_total_chunk_size() == 8192
+        from ceph_tpu.checksum.reference import crc32c_ref
+
+        for s in range(6):
+            expect = crc32c_ref(0xFFFFFFFF, bytes(sem.get(s, 0, 8192)))
+            assert hi.get_chunk_hash(s) == expect
+
     def test_encode_updates_hashinfo(self, sinfo, codec, rng):
         data = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
         sem = ShardExtentMap(sinfo)
