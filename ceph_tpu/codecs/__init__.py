@@ -31,3 +31,4 @@ from . import jerasure as _jerasure  # noqa: E402,F401
 from . import isa as _isa  # noqa: E402,F401
 from . import lrc as _lrc  # noqa: E402,F401
 from . import shec as _shec  # noqa: E402,F401
+from . import clay as _clay  # noqa: E402,F401

@@ -19,8 +19,8 @@ def honor_platform_env() -> None:
     No-op when the env var is unset or requests non-CPU platforms —
     the default (tunnel/TPU) path stays untouched.
     """
-    want = os.environ.get("JAX_PLATFORMS", "")
-    if "cpu" in want.split(","):
+    want = [p.strip() for p in os.environ.get("JAX_PLATFORMS", "").split(",") if p.strip()]
+    if want == ["cpu"]:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
