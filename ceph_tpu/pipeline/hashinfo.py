@@ -24,8 +24,16 @@ class HashInfo:
         self.total_chunk_size = 0
         self.cumulative_shard_hashes = [SEED] * num_chunks
 
-    def append(self, old_size: int, to_append: dict[int, np.ndarray]) -> None:
+    def append(
+        self,
+        old_size: int,
+        to_append: "dict[int, np.ndarray | bytes | bytearray | memoryview]",
+    ) -> None:
         """Extend shard crcs with bytes written at ``old_size``.
+
+        Values are raw shard bytes: bytes-like taken as-is, ndarrays
+        must already be uint8 (no silent value casts — the crc is over
+        stored bytes, so a lossy cast would hide corruption).
 
         The reference asserts appends are contiguous and equal-length
         across shards (HashInfo::append, ECUtil.cc); same contract here.
@@ -35,11 +43,20 @@ class HashInfo:
                 f"non-contiguous append: old_size={old_size}, "
                 f"have={self.total_chunk_size}"
             )
-        sizes = {int(np.asarray(b).size) for b in to_append.values()}
+
+        def as_bytes(b) -> bytes:
+            if isinstance(b, (bytes, bytearray, memoryview)):
+                return bytes(b)
+            arr = np.asarray(b)
+            if arr.dtype != np.uint8:
+                raise TypeError(f"shard bytes must be uint8, got {arr.dtype}")
+            return arr.tobytes()
+
+        bufs = {shard: as_bytes(b) for shard, b in to_append.items()}
+        sizes = {len(b) for b in bufs.values()}
         if len(sizes) > 1:
             raise ValueError(f"unequal append sizes {sizes}")
-        for shard, buf in to_append.items():
-            data = bytes(np.asarray(buf, dtype=np.uint8))
+        for shard, data in bufs.items():
             self.cumulative_shard_hashes[shard] = crc32c_ref(
                 self.cumulative_shard_hashes[shard], data
             )

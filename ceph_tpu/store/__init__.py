@@ -1,0 +1,14 @@
+"""Local object persistence — the ``ObjectStore`` boundary.
+
+Behavioral mirror of the reference's store contract
+(src/os/ObjectStore.h ``queue_transactions`` + src/os/Transaction.h):
+writes arrive as ordered, atomic ``Transaction`` op lists; reads are
+direct. ``MemStore`` (src/os/memstore/) is the in-RAM implementation
+the reference uses to run its OSD pipeline tests hardware-free; ours
+plays the same role for the TPU pipeline tests.
+"""
+
+from .transaction import Op, OpKind, Transaction
+from .memstore import MemStore
+
+__all__ = ["MemStore", "Op", "OpKind", "Transaction"]

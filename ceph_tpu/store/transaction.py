@@ -1,0 +1,83 @@
+"""Atomic store transactions — the ``ceph::os::Transaction`` analog.
+
+Mirrors src/os/Transaction.h: an ordered op list applied atomically by
+a store. The op vocabulary is the subset the EC pipeline emits from
+``generate_transactions`` (osd/ECTransaction.cc:916): touch, write,
+zero, truncate, remove, setattr, rmattr. Each op is a plain record;
+the store interprets them (src/os/memstore/MemStore.cc
+``_do_transaction`` pattern).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class OpKind(enum.Enum):
+    TOUCH = "touch"
+    WRITE = "write"
+    ZERO = "zero"
+    TRUNCATE = "truncate"
+    REMOVE = "remove"
+    SETATTR = "setattr"
+    RMATTR = "rmattr"
+
+
+@dataclass
+class Op:
+    kind: OpKind
+    oid: str
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+    name: str = ""
+
+
+@dataclass
+class Transaction:
+    """Ordered op list; built fluently, applied atomically."""
+
+    ops: list[Op] = field(default_factory=list)
+
+    def touch(self, oid: str) -> "Transaction":
+        self.ops.append(Op(OpKind.TOUCH, oid))
+        return self
+
+    def write(self, oid: str, offset: int, data: bytes) -> "Transaction":
+        self.ops.append(
+            Op(OpKind.WRITE, oid, offset=offset, length=len(data),
+               data=bytes(data))
+        )
+        return self
+
+    def zero(self, oid: str, offset: int, length: int) -> "Transaction":
+        self.ops.append(Op(OpKind.ZERO, oid, offset=offset, length=length))
+        return self
+
+    def truncate(self, oid: str, size: int) -> "Transaction":
+        self.ops.append(Op(OpKind.TRUNCATE, oid, offset=size))
+        return self
+
+    def remove(self, oid: str) -> "Transaction":
+        self.ops.append(Op(OpKind.REMOVE, oid))
+        return self
+
+    def setattr(self, oid: str, name: str, value: bytes) -> "Transaction":
+        self.ops.append(Op(OpKind.SETATTR, oid, name=name, data=bytes(value)))
+        return self
+
+    def rmattr(self, oid: str, name: str) -> "Transaction":
+        self.ops.append(Op(OpKind.RMATTR, oid, name=name))
+        return self
+
+    def append(self, other: "Transaction") -> "Transaction":
+        """Concatenate another transaction's ops (Transaction::append)."""
+        self.ops.extend(other.ops)
+        return self
+
+    def empty(self) -> bool:
+        return not self.ops
+
+    def __len__(self) -> int:
+        return len(self.ops)

@@ -1,0 +1,279 @@
+"""RMW pipeline + extent cache semantics.
+
+Models the reference's write-path contracts: WritePlan strategy choice
+(ECTransaction.cc:77-79), extent-cache hit/miss + single outstanding
+read + FIFO (ECExtentCache.h:4-74), generate_transactions output
+(ECTransaction.cc:916), and in-order commit (ECCommon.h:553-555).
+Verification is end-to-end: after every write, all k+m shard stores
+decode back to the client's bytes under any m erasures.
+"""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import Flag, registry
+from ceph_tpu.pipeline.extent_cache import ECExtentCache, LINE_SIZE
+from ceph_tpu.pipeline.extents import ExtentSet
+from ceph_tpu.pipeline.hashinfo import HashInfo
+from ceph_tpu.pipeline.rmw import (
+    HINFO_KEY,
+    RMWPipeline,
+    ShardBackend,
+    plan_write,
+)
+from ceph_tpu.pipeline.shard_map import ShardExtentMap
+from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
+from ceph_tpu.store import MemStore
+
+
+K, M = 4, 2
+CHUNK = PAGE_SIZE  # 4K chunks -> 16K stripe
+
+
+def make_pipeline(k=K, m=M, chunk=CHUNK):
+    sinfo = StripeInfo(k, m, k * chunk)
+    codec = registry.factory(
+        "jerasure", {"technique": "reed_sol_van", "k": str(k), "m": str(m)}
+    )
+    backend = ShardBackend({s: MemStore(f"osd.{s}") for s in range(k + m)})
+    return RMWPipeline(sinfo, codec, backend), sinfo, codec, backend
+
+
+def reconstruct_object(pipe, sinfo, codec, oid, size, lost=()):
+    """Read every shard store (minus ``lost``), decode, reassemble ro
+    bytes — the full degraded-read check."""
+    smap = ShardExtentMap(sinfo)
+    for shard, store in pipe.backend.stores.items():
+        if shard in lost or not store.exists(oid):
+            continue
+        buf = store.read(oid)
+        smap.insert(shard, 0, np.frombuffer(buf, np.uint8))
+    want = {sinfo.get_shard(r) for r in range(sinfo.k)}
+    smap.decode(codec, want, size)
+    out = np.zeros(size, dtype=np.uint8)
+    pos = 0
+    while pos < size:
+        chunk_index = pos // sinfo.chunk_size
+        raw = chunk_index % sinfo.k
+        in_chunk = pos % sinfo.chunk_size
+        take = min(sinfo.chunk_size - in_chunk, size - pos)
+        shard_off = (chunk_index // sinfo.k) * sinfo.chunk_size + in_chunk
+        out[pos : pos + take] = smap.get(
+            sinfo.get_shard(raw), shard_off, take
+        )
+        pos += take
+    return bytes(out)
+
+
+# -- WritePlan ----------------------------------------------------------
+def test_plan_new_object_is_full_stripe():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    plan = plan_write(
+        sinfo, Flag.PARITY_DELTA_OPTIMIZATION, 0, K * CHUNK, object_size=0
+    )
+    assert not plan.do_parity_delta
+    assert plan.read_bytes() == 0
+
+
+def test_plan_small_overwrite_prefers_parity_delta():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    # one chunk of a fully-written large object: delta reads 1 data +
+    # 2 parity chunks; full-stripe reads 3 data chunks + 0.
+    plan = plan_write(
+        sinfo,
+        Flag.PARITY_DELTA_OPTIMIZATION,
+        0,
+        CHUNK,
+        object_size=8 * K * CHUNK,
+    )
+    assert plan.do_parity_delta
+
+
+def test_plan_no_delta_flag_forces_full_stripe():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    plan = plan_write(sinfo, Flag.NONE, 0, CHUNK, object_size=8 * K * CHUNK)
+    assert not plan.do_parity_delta
+    # reads the other k-1 chunks of the stripe
+    assert plan.read_bytes() == (K - 1) * CHUNK
+
+
+def test_plan_full_stripe_overwrite_needs_no_reads():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    plan = plan_write(
+        sinfo,
+        Flag.PARITY_DELTA_OPTIMIZATION,
+        K * CHUNK,
+        K * CHUNK,
+        object_size=4 * K * CHUNK,
+    )
+    if not plan.do_parity_delta:
+        assert plan.read_bytes() == 0
+
+
+# -- end-to-end writes --------------------------------------------------
+def test_full_stripe_write_and_degraded_read(rng):
+    pipe, sinfo, codec, _ = make_pipeline()
+    payload = bytes(rng.integers(0, 256, K * CHUNK, dtype=np.uint8))
+    committed = []
+    pipe.submit("obj", 0, payload, on_commit=lambda op: committed.append(op.tid))
+    assert committed == [1]
+    for lost in combinations(range(K + M), M):
+        got = reconstruct_object(
+            pipe, sinfo, codec, "obj", len(payload), lost=lost
+        )
+        assert got == payload, f"lost={lost}"
+
+
+def test_append_then_overwrite_rmw(rng):
+    pipe, sinfo, codec, _ = make_pipeline()
+    base = bytes(rng.integers(0, 256, 2 * K * CHUNK, dtype=np.uint8))
+    pipe.submit("obj", 0, base)
+    # partial overwrite inside stripe 0 (parity-delta candidate)
+    patch = bytes(rng.integers(0, 256, CHUNK, dtype=np.uint8))
+    pipe.submit("obj", CHUNK, patch)
+    expect = bytearray(base)
+    expect[CHUNK : 2 * CHUNK] = patch
+    for lost in combinations(range(K + M), M):
+        got = reconstruct_object(
+            pipe, sinfo, codec, "obj", len(base), lost=lost
+        )
+        assert got == bytes(expect), f"lost={lost}"
+
+
+def test_unaligned_sub_page_write(rng):
+    pipe, sinfo, codec, _ = make_pipeline()
+    base = bytes(rng.integers(0, 256, K * CHUNK, dtype=np.uint8))
+    pipe.submit("obj", 0, base)
+    patch = b"\xAB" * 100
+    pipe.submit("obj", 37, patch)
+    expect = bytearray(base)
+    expect[37 : 137] = patch
+    got = reconstruct_object(pipe, sinfo, codec, "obj", len(base), lost=(0, 4))
+    assert got == bytes(expect)
+
+
+def test_multi_stripe_append_grows_object(rng):
+    pipe, sinfo, codec, _ = make_pipeline()
+    a = bytes(rng.integers(0, 256, K * CHUNK, dtype=np.uint8))
+    b = bytes(rng.integers(0, 256, 3 * K * CHUNK, dtype=np.uint8))
+    pipe.submit("obj", 0, a)
+    pipe.submit("obj", len(a), b)
+    assert pipe.object_size("obj") == len(a) + len(b)
+    got = reconstruct_object(
+        pipe, sinfo, codec, "obj", len(a) + len(b), lost=(1, 5)
+    )
+    assert got == a + b
+
+
+def test_hinfo_maintained_on_append_cleared_on_overwrite(rng):
+    pipe, sinfo, codec, backend = make_pipeline()
+    a = bytes(rng.integers(0, 256, K * CHUNK, dtype=np.uint8))
+    pipe.submit("obj", 0, a)
+    hi = pipe.hinfo("obj")
+    assert hi.get_total_chunk_size() == CHUNK
+    # stored attr matches pipeline state on every shard
+    for store in backend.stores.values():
+        assert HashInfo.from_bytes(store.getattr("obj", HINFO_KEY)) == hi
+    # appending extends
+    pipe.submit("obj", len(a), a)
+    assert pipe.hinfo("obj").get_total_chunk_size() == 2 * CHUNK
+    # overwrite invalidates
+    pipe.submit("obj", 0, b"\x01" * 64)
+    assert pipe.hinfo("obj").get_total_chunk_size() == 0
+
+
+def test_in_order_commit_with_out_of_order_acks(rng):
+    pipe, sinfo, codec, backend = make_pipeline()
+    payload = bytes(rng.integers(0, 256, K * CHUNK, dtype=np.uint8))
+    backend.defer_acks = True
+    committed = []
+    t1 = pipe.submit("a", 0, payload, on_commit=lambda op: committed.append(op.tid))
+    t2 = pipe.submit("b", 0, payload, on_commit=lambda op: committed.append(op.tid))
+    assert committed == []
+    # ack op2's shards first: its commit must WAIT for op1
+    acks = backend.deferred
+    backend.deferred = []
+    for shard, ack in acks[K + M :]:  # op2's acks
+        ack()
+    assert committed == []
+    for shard, ack in acks[: K + M]:  # op1's acks
+        ack()
+    assert committed == [t1, t2]
+
+
+# -- extent cache -------------------------------------------------------
+def test_cache_hit_after_write_skips_backend_read(rng):
+    pipe, sinfo, codec, backend = make_pipeline()
+    payload = bytes(rng.integers(0, 256, K * CHUNK, dtype=np.uint8))
+    pipe.submit("obj", 0, payload)
+    misses0 = pipe.cache.stat_misses
+    # overwrite part of the same (cached) stripe: RMW read should hit
+    pipe.submit("obj", 0, b"\x55" * 256)
+    assert pipe.cache.stat_misses == misses0
+    assert pipe.cache.stat_hits >= 1
+
+
+def test_cache_single_outstanding_read_and_fifo():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    issued = []
+    cache = ECExtentCache(sinfo, lambda oid, want: issued.append((oid, want)))
+    ready = []
+    ops = []
+    for name in ("x", "y"):
+        op = cache.prepare(
+            name,
+            {0: ExtentSet([(0, 512)])},
+            {0: ExtentSet([(0, 512)])},
+            512,
+            lambda op: ready.append(op.oid),
+        )
+        ops.append(op)
+    cache.execute(ops)
+    assert [oid for oid, _ in issued] == ["x"]  # one outstanding
+    smap = ShardExtentMap(sinfo)
+    smap.insert(0, 0, np.zeros(512, np.uint8))
+    cache.read_done("x", smap)
+    assert ready == ["x"]
+    assert [oid for oid, _ in issued] == ["x", "y"]
+    cache.read_done("y", smap)
+    assert ready == ["x", "y"]
+
+
+def test_cache_lru_eviction_unpinned_only():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    cache = ECExtentCache(sinfo, lambda oid, want: None, capacity_lines=2)
+    done = []
+    ops = []
+    for i in range(4):
+        op = cache.prepare(
+            f"o{i}",
+            None,
+            {0: ExtentSet([(i * LINE_SIZE, i * LINE_SIZE + 128)])},
+            LINE_SIZE * 4,
+            lambda op: done.append(op.oid),
+        )
+        ops.append(op)
+    cache.execute(ops)
+    assert len(done) == 4
+    for i, op in enumerate(ops):
+        smap = ShardExtentMap(sinfo)
+        smap.insert(0, i * LINE_SIZE, np.full(128, i, np.uint8))
+        cache.write_done(op, smap)
+    assert cache.lru_size() <= 2
+
+
+def test_cache_on_change_drops_state():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    cache = ECExtentCache(sinfo, lambda oid, want: None)
+    op = cache.prepare(
+        "o", None, {0: ExtentSet([(0, 128)])}, 128, lambda op: None
+    )
+    cache.execute([op])
+    smap = ShardExtentMap(sinfo)
+    smap.insert(0, 0, np.ones(128, np.uint8))
+    cache.write_done(op, smap)
+    assert cache.lru_size() >= 0
+    cache.on_change()
+    assert cache.lru_size() == 0

@@ -1,0 +1,111 @@
+"""MemStore + Transaction semantics (the store_test.cc analog subset)."""
+
+import pytest
+
+from ceph_tpu.pipeline.hashinfo import HashInfo
+from ceph_tpu.store import MemStore, Transaction
+
+
+def test_write_read_roundtrip():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"hello"))
+    assert st.read("o") == b"hello"
+    assert st.stat("o") == 5
+
+
+def test_write_extends_with_zero_fill():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 8, b"xy"))
+    assert st.read("o") == b"\0" * 8 + b"xy"
+
+
+def test_overwrite_middle():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"aaaaaaaa"))
+    st.queue_transactions(Transaction().write("o", 2, b"BB"))
+    assert st.read("o") == b"aaBBaaaa"
+
+
+def test_zero_and_truncate():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"abcdefgh"))
+    st.queue_transactions(Transaction().zero("o", 2, 3))
+    assert st.read("o") == b"ab\0\0\0fgh"
+    st.queue_transactions(Transaction().truncate("o", 4))
+    assert st.stat("o") == 4
+    st.queue_transactions(Transaction().truncate("o", 6))
+    assert st.read("o") == b"ab\0\0\0\0"
+
+
+def test_short_read_past_eof():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"abc"))
+    assert st.read("o", 2, 100) == b"c"
+
+
+def test_touch_creates_empty():
+    st = MemStore()
+    st.queue_transactions(Transaction().touch("o"))
+    assert st.exists("o")
+    assert st.stat("o") == 0
+
+
+def test_remove():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"x"))
+    st.queue_transactions(Transaction().remove("o"))
+    assert not st.exists("o")
+    with pytest.raises(FileNotFoundError):
+        st.read("o")
+
+
+def test_remove_then_recreate_in_one_txn():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"old"))
+    st.queue_transactions(Transaction().remove("o").write("o", 0, b"new"))
+    assert st.read("o") == b"new"
+
+
+def test_attrs_roundtrip_hashinfo():
+    st = MemStore()
+    hi = HashInfo(6)
+    hi.append(0, {i: b"\x01" * 8 for i in range(6)})
+    st.queue_transactions(
+        Transaction().touch("o").setattr("o", "hinfo", hi.to_bytes())
+    )
+    assert HashInfo.from_bytes(st.getattr("o", "hinfo")) == hi
+    st.queue_transactions(Transaction().rmattr("o", "hinfo"))
+    with pytest.raises(KeyError):
+        st.getattr("o", "hinfo")
+
+
+def test_atomicity_failed_txn_leaves_no_state():
+    st = MemStore()
+    st.queue_transactions(Transaction().write("o", 0, b"keep"))
+    bad = Transaction().write("o", 0, b"clobber").remove("missing")
+    with pytest.raises(FileNotFoundError):
+        st.queue_transactions(bad)
+    assert st.read("o") == b"keep"  # first op rolled back too
+
+
+def test_ordered_multi_txn_batch():
+    st = MemStore()
+    seq = st.queue_transactions(
+        [
+            Transaction().write("o", 0, b"v1"),
+            Transaction().write("o", 0, b"v2"),
+        ]
+    )
+    assert st.read("o") == b"v2"
+    assert seq == 1
+    assert st.queue_transactions(Transaction().touch("p")) == 2
+
+
+def test_missing_object_errors():
+    st = MemStore()
+    with pytest.raises(FileNotFoundError):
+        st.stat("nope")
+    with pytest.raises(FileNotFoundError):
+        st.getattr("nope", "a")
+    with pytest.raises(FileNotFoundError):
+        st.queue_transactions(Transaction().remove("nope"))
