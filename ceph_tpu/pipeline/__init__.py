@@ -11,10 +11,13 @@ from .extents import ExtentSet
 from .hashinfo import HashInfo
 from .stripe import StripeInfo
 from .shard_map import ShardExtentMap
+from .read import ReadPipeline, ShardReadError
 
 __all__ = [
     "ExtentSet",
     "HashInfo",
     "StripeInfo",
     "ShardExtentMap",
+    "ReadPipeline",
+    "ShardReadError",
 ]

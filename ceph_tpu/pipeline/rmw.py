@@ -89,8 +89,13 @@ def plan_write(
                 out.insert(s, min(e, stored) - s)
         return out
 
+    # Subtract only the bytes the client actually overwrites (the
+    # UNALIGNED extents): a sub-page boundary still needs its old bytes
+    # read so the re-encode and the page write both see them — aligned
+    # extents here once dropped boundary bytes, encoding zeros into
+    # parity while the store kept the old data (silent corruption).
     data_written = {
-        s: es for s, es in to_write.items() if sinfo.is_data_shard(s)
+        s: es for s, es in touched.items() if sinfo.is_data_shard(s)
     }
 
     # Full-stripe read set: chunk-aligned hull minus what we overwrite.
@@ -157,14 +162,56 @@ class ShardBackend:
     Read fan-out seam). The local implementation writes straight into
     per-shard MemStores; the distributed layer substitutes messengers.
 
-    ``defer_acks``: tests set this to capture ack callbacks and release
-    them out of order, exercising the in-order commit queue.
+    ``defer_acks``/``defer_reads``: tests set these to capture callbacks
+    and release them out of order, exercising the in-order queues.
+    ``down_shards``/``fail_read_shards``: availability + EIO injection
+    (the ECInject seam — reads from those shards error).
     """
 
     def __init__(self, stores: dict[int, "object"]) -> None:
         self.stores = stores
         self.defer_acks = False
         self.deferred: list[tuple[int, Callable[[], None]]] = []
+        self.down_shards: set[int] = set()
+        self.fail_read_shards: set[int] = set()
+        self.defer_reads = False
+        self.deferred_reads: list[tuple[int, Callable[[], None]]] = []
+
+    def avail_shards(self) -> set[int]:
+        """Shards the read planner may target (acting-set analog)."""
+        return set(self.stores) - self.down_shards
+
+    def read_shard_async(
+        self,
+        shard: int,
+        oid: str,
+        extents: ExtentSet,
+        cb: Callable[[int, "dict[int, bytes] | Exception"], None],
+    ) -> None:
+        """Sub-read fan-out seam (ECSubRead → handle_sub_read). Calls
+        ``cb(shard, {offset: bytes})`` or ``cb(shard, ShardReadError)``."""
+        from .read import ShardReadError
+
+        def run() -> None:
+            if shard in self.fail_read_shards or shard in self.down_shards:
+                cb(shard, ShardReadError(shard, oid))
+            else:
+                cb(shard, self.read_shard(shard, oid, extents))
+
+        if self.defer_reads:
+            self.deferred_reads.append((shard, run))
+        else:
+            run()
+
+    def release_deferred_reads(self, order: list[int] | None = None) -> None:
+        pending = self.deferred_reads
+        self.deferred_reads = []
+        if order is not None:
+            pending = sorted(
+                pending, key=lambda t: order.index(t[0]) if t[0] in order else 99
+            )
+        for _, run in pending:
+            run()
 
     def read_shard(self, shard: int, oid: str, extents: ExtentSet) -> dict[int, bytes]:
         store = self.stores[shard]

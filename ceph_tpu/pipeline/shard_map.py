@@ -150,26 +150,44 @@ class ShardExtentMap:
         when given (the encode-time HashInfo append, ECUtil.cc:521-534).
         """
         k, m = self.sinfo.k, self.sinfo.m
-        lo, hi = self._slice_window()
-        if hi <= lo:
+        lo0, hi0 = self._slice_window()
+        if hi0 <= lo0:
             return
+        # Chunk-align the dispatch window and batch per chunk: codecs
+        # with intra-chunk structure (CLAY sub-chunks) need real chunk
+        # boundaries, and the chunk axis is a free MXU batch axis. The
+        # HASH window below stays page-aligned (lo0/hi0): hashed size
+        # must track what the client wrote so contiguous appends keep
+        # extending the cumulative CRCs when chunk_size > PAGE_SIZE.
+        cs = self.sinfo.chunk_size
+        lo = (lo0 // cs) * cs
+        hi = -(-hi0 // cs) * cs
+        n_chunks = (hi - lo) // cs
         data = np.stack(
-            [self.get(self.sinfo.get_shard(r), lo, hi - lo) for r in range(k)]
+            [
+                self.get(self.sinfo.get_shard(r), lo, hi - lo).reshape(
+                    n_chunks, cs
+                )
+                for r in range(k)
+            ]
         )
         parity = self._dispatch_encode(codec, data)
         for j in range(m):
-            self.insert(self.sinfo.get_shard(k + j), lo, parity[j])
+            self.insert(
+                self.sinfo.get_shard(k + j), lo, parity[j].reshape(-1)
+            )
         if hashinfo is not None:
             # Appends must be contiguous and equal-length across shards
             # (the HashInfo contract): hash every shard's zero-padded
-            # tail up to the common window end.
-            base = lo if old_size is None else old_size
-            if hi > base:
+            # tail up to the common PAGE window end (not the chunk-
+            # aligned dispatch window — see comment above).
+            base = lo0 if old_size is None else old_size
+            if hi0 > base:
                 hashinfo.append(
                     base,
                     {
                         self.sinfo.get_shard(raw): self.get(
-                            self.sinfo.get_shard(raw), base, hi - base
+                            self.sinfo.get_shard(raw), base, hi0 - base
                         )
                         for raw in range(k + m)
                     },
@@ -248,27 +266,36 @@ class ShardExtentMap:
         )
         if not missing_raw:
             return
-        present_raw = sorted(
-            sinfo.get_raw_shard(s) for s in self._bufs
+        cs = sinfo.chunk_size
+        hull = sinfo.chunk_aligned_hull(
+            self.get_extent_set(shard) for shard in self._bufs
         )
-        lo, hi = None, None
-        for shard in self._bufs:
-            es = self.get_extent_set(shard)
-            if es:
-                s0 = align_page_prev(es.range_start())
-                e0 = align_page_next(es.range_end())
-                lo = s0 if lo is None else min(lo, s0)
-                hi = e0 if hi is None else max(hi, e0)
-        if lo is None or hi <= lo:
+        if hull is None or hull[1] <= hull[0]:
             return
+        lo, hi = hull
+        # Survivors must cover the stored part of the window: a shard
+        # holding only a sub-range would decode zero-filled gaps into
+        # the output (absent bytes are zero ONLY beyond shard size).
+        present_raw = []
+        for shard in self._bufs:
+            ssize = sinfo.object_size_to_shard_size(object_size, shard)
+            end = min(hi, ssize)
+            if end <= lo or self.get_extent_set(shard).contains(lo, end - lo):
+                present_raw.append(sinfo.get_raw_shard(shard))
+        present_raw.sort()
+        n_chunks = (hi - lo) // cs
         chunks = {
-            raw: jnp.asarray(self.get(sinfo.get_shard(raw), lo, hi - lo))
+            raw: jnp.asarray(
+                self.get(sinfo.get_shard(raw), lo, hi - lo).reshape(
+                    n_chunks, cs
+                )
+            )
             for raw in present_raw
         }
         out = codec.decode_chunks(set(missing_raw), chunks)
         for raw in missing_raw:
             shard = sinfo.get_shard(raw)
-            buf = np.asarray(out[raw])
+            buf = np.asarray(out[raw]).reshape(-1)
             shard_size = sinfo.object_size_to_shard_size(object_size, shard)
             end = min(hi, shard_size)
             if end > lo:

@@ -161,6 +161,22 @@ class StripeInfo:
                 )
         return out
 
+    def chunk_aligned_hull(self, extent_sets) -> tuple[int, int] | None:
+        """Chunk-aligned [lo, hi) hull over shard-offset extent sets —
+        the window every decode/encode dispatch covers. None if empty."""
+        cs = self.chunk_size
+        lo = hi = None
+        for es in extent_sets:
+            if not es:
+                continue
+            s0 = (es.range_start() // cs) * cs
+            e0 = -(-es.range_end() // cs) * cs
+            lo = s0 if lo is None else min(lo, s0)
+            hi = e0 if hi is None else max(hi, e0)
+        if lo is None:
+            return None
+        return lo, hi
+
     def __repr__(self) -> str:
         return (
             f"StripeInfo(k={self.k}, m={self.m}, "
