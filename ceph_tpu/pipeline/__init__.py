@@ -12,6 +12,7 @@ from .hashinfo import HashInfo
 from .stripe import StripeInfo
 from .shard_map import ShardExtentMap
 from .read import ReadPipeline, ShardReadError
+from .recovery import RecoveryBackend, RecoveryState, be_deep_scrub
 
 __all__ = [
     "ExtentSet",
@@ -20,4 +21,7 @@ __all__ = [
     "ShardExtentMap",
     "ReadPipeline",
     "ShardReadError",
+    "RecoveryBackend",
+    "RecoveryState",
+    "be_deep_scrub",
 ]

@@ -164,6 +164,86 @@ def gather_ro_range(
     return out.tobytes()
 
 
+def reconstruct_shards(
+    sinfo: StripeInfo,
+    codec,
+    result: ShardExtentMap,
+    want: dict[int, ExtentSet],
+    shard_reads: dict[int, ShardRead],
+    object_size: int,
+    error_shards: frozenset[int] | set[int] = frozenset(),
+) -> None:
+    """Fill wanted-but-unread shards of ``result`` from its survivors.
+
+    Shared by the client read path and shard recovery: CLAY fractional
+    repair when the plan carried sub-chunk selectors and exactly one
+    shard is lost, plain windowed decode otherwise.
+    """
+    lost = set()
+    for s, es in want.items():
+        got = result.get_extent_set(s)
+        if any(not got.contains(a, b - a) for a, b in es):
+            lost.add(s)
+    if not lost:
+        return
+    fractional = any(sr.subchunks is not None for sr in shard_reads.values())
+    if fractional and len(lost) == 1 and hasattr(codec, "repair"):
+        _repair_fractional(
+            sinfo, codec, result, want, shard_reads, object_size,
+            error_shards, lost,
+        )
+        return
+    result.decode(codec, lost, object_size)
+
+
+def _repair_fractional(
+    sinfo: StripeInfo,
+    codec,
+    result: ShardExtentMap,
+    want: dict[int, ExtentSet],
+    shard_reads: dict[int, ShardRead],
+    object_size: int,
+    error_shards,
+    lost: set[int],
+) -> None:
+    """CLAY fractional repair: per chunk in the window, feed each
+    helper's concatenated repair sub-chunks to ``codec.repair``."""
+    cs = sinfo.chunk_size
+    want_raw = {sinfo.get_raw_shard(s) for s in lost}
+    helpers = {
+        s: sr for s, sr in shard_reads.items()
+        if s not in error_shards and s not in lost
+        and sr.subchunks is not None
+    }
+    # Window = chunk hull of the wanted extents.
+    lo, hi = sinfo.chunk_aligned_hull(want.values())
+    n_chunks = (hi - lo) // cs
+    import jax.numpy as jnp
+
+    chunks_in: dict[int, "jnp.ndarray"] = {}
+    for shard, sr in helpers.items():
+        rows = []
+        for c in range(n_chunks):
+            base = lo + c * cs
+            sel = subchunk_byte_extents(
+                ExtentSet([(base, base + cs)]),
+                cs,
+                codec.get_sub_chunk_count(),
+                sr.subchunks or [(0, codec.get_sub_chunk_count())],
+            )
+            parts = [result.get(shard, s, e - s) for s, e in sel]
+            rows.append(np.concatenate(parts))
+        chunks_in[sinfo.get_raw_shard(shard)] = jnp.asarray(np.stack(rows))
+    out = codec.repair(want_raw, chunks_in)
+    for raw in want_raw:
+        shard = sinfo.get_shard(raw)
+        buf = np.asarray(out[raw]).reshape(n_chunks * cs)
+        shard_size = sinfo.object_size_to_shard_size(object_size, shard)
+        end = min(hi, shard_size)
+        if end > lo:
+            result.insert(shard, lo, buf[: end - lo])
+
+
 class ClientReadOp:
     """One in-flight client read (ECCommon::ClientAsyncReadStatus +
     read_request_t rolled together)."""
@@ -350,73 +430,18 @@ class ReadPipeline:
             )
         self._finish(op)
 
-    def _lost_want(self, op: ClientReadOp) -> set[int]:
-        """Wanted shards whose extents were never directly read."""
-        lost = set()
-        for s, es in op.want.items():
-            got = op.result.get_extent_set(s)
-            if any(not got.contains(a, b - a) for a, b in es):
-                lost.add(s)
-        return lost
-
     def _reconstruct(self, op: ClientReadOp) -> None:
         """Decode missing wanted shards from the survivors in
         ``op.result`` (complete_read_op → shard_extent_map_t::decode)."""
-        lost = self._lost_want(op)
-        if not lost:
-            return
-        fractional = any(
-            sr.subchunks is not None for sr in op.shard_reads.values()
+        reconstruct_shards(
+            self.sinfo,
+            self.codec,
+            op.result,
+            op.want,
+            op.shard_reads,
+            self.size_fn(op.oid),
+            op.error_shards,
         )
-        if fractional and len(lost) == 1 and hasattr(self.codec, "repair"):
-            self._repair_fractional(op, lost)
-            return
-        op.result.decode(self.codec, lost, self.size_fn(op.oid))
-
-    def _repair_fractional(self, op: ClientReadOp, lost: set[int]) -> None:
-        """CLAY fractional repair: per chunk in the window, feed each
-        helper's concatenated repair sub-chunks to ``codec.repair``."""
-        sinfo = self.sinfo
-        cs = sinfo.chunk_size
-        want_raw = {sinfo.get_raw_shard(s) for s in lost}
-        helpers = {
-            s: sr for s, sr in op.shard_reads.items()
-            if s not in op.error_shards
-            and s not in lost
-            and sr.subchunks is not None
-        }
-        # Window = chunk hull of the wanted extents.
-        lo, hi = sinfo.chunk_aligned_hull(op.want.values())
-        n_chunks = (hi - lo) // cs
-        import jax.numpy as jnp
-
-        chunks_in: dict[int, "jnp.ndarray"] = {}
-        for shard, sr in helpers.items():
-            rows = []
-            for c in range(n_chunks):
-                base = lo + c * cs
-                sel = subchunk_byte_extents(
-                    ExtentSet([(base, base + cs)]),
-                    cs,
-                    self.codec.get_sub_chunk_count(),
-                    sr.subchunks or [(0, self.codec.get_sub_chunk_count())],
-                )
-                parts = [
-                    op.result.get(shard, s, e - s) for s, e in sel
-                ]
-                rows.append(np.concatenate(parts))
-            chunks_in[sinfo.get_raw_shard(shard)] = jnp.asarray(
-                np.stack(rows)
-            )
-        out = self.codec.repair(want_raw, chunks_in)
-        size = self.size_fn(op.oid)
-        for raw in want_raw:
-            shard = sinfo.get_shard(raw)
-            buf = np.asarray(out[raw]).reshape(n_chunks * cs)
-            shard_size = sinfo.object_size_to_shard_size(size, shard)
-            end = min(hi, shard_size)
-            if end > lo:
-                op.result.insert(shard, lo, buf[: end - lo])
 
     def _finish(self, op: ClientReadOp) -> None:
         """In-order completion (in_progress_client_reads semantics)."""

@@ -161,6 +161,20 @@ class StripeInfo:
                 )
         return out
 
+    def object_size_to_exact_shard_size(self, size: int, shard: int) -> int:
+        """Bytes the write path actually stores on ``shard``: data
+        shards keep the exact (unpadded) tail; parity shards are
+        written for every touched page, so they stay page-aligned."""
+        raw = self.get_raw_shard(shard)
+        if raw >= self.k:
+            return self.object_size_to_shard_size(size, shard)
+        remainder = size % self.stripe_width
+        shard_size = (size - remainder) // self.k
+        skip = raw * self.chunk_size
+        if remainder > skip:
+            shard_size += min(remainder - skip, self.chunk_size)
+        return shard_size
+
     def chunk_aligned_hull(self, extent_sets) -> tuple[int, int] | None:
         """Chunk-aligned [lo, hi) hull over shard-offset extent sets —
         the window every decode/encode dispatch covers. None if empty."""
