@@ -1,0 +1,128 @@
+"""Fault injection — the ``ECInject`` analog (osd/ECInject.{h,cc}).
+
+A process-global registry of per-object (optionally per-shard) error
+injections, consulted from the sub-read / sub-write dispatch paths
+exactly where the reference hooks ``handle_sub_read`` /
+``handle_sub_write``:
+
+- read type 0: sub-read fails with EIO.
+- read type 1: shard reports the object missing (ENOENT-alike) —
+  exercises the same retry path with a different error class.
+- write type 0: the client write op fails before dispatch (abort).
+- write type 1: the sub-write to a shard is silently dropped — the ack
+  never arrives, leaving the op parked in the in-order commit queue
+  (the rollback-forcing inject of the reference).
+
+Each injection has ``when`` (ops to let through first) and ``duration``
+(ops to affect) counters, matching the reference's tell-command
+parameters (ECInject.cc:47-69). Thread-safe; tests and the chaos
+harness drive it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+ANY_SHARD = -1
+
+
+@dataclass
+class _Rule:
+    when: int
+    duration: int
+
+    def fires(self) -> bool:
+        """Count an op against this rule; True if the error injects."""
+        if self.when > 0:
+            self.when -= 1
+            return False
+        if self.duration > 0:
+            self.duration -= 1
+            return True
+        return False
+
+    @property
+    def exhausted(self) -> bool:
+        return self.when <= 0 and self.duration <= 0
+
+
+class ECInject:
+    """Global error-inject registry (singleton via module instance)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (kind, type, oid, shard) -> _Rule
+        self._rules: dict[tuple[str, int, str, int], _Rule] = {}
+        self.injected_count = 0
+
+    # -- operator surface (the `ceph tell` analog) ---------------------
+    def read_error(
+        self, oid: str, type: int, when: int = 0, duration: int = 1,
+        shard: int = ANY_SHARD,
+    ) -> str:
+        if type not in (0, 1):
+            return "unrecognized error inject type"
+        with self._lock:
+            self._rules[("read", type, oid, shard)] = _Rule(when, duration)
+        return f"ok: read error type {type} on {oid}"
+
+    def write_error(
+        self, oid: str, type: int, when: int = 0, duration: int = 1,
+        shard: int = ANY_SHARD,
+    ) -> str:
+        if type not in (0, 1):
+            return "unrecognized error inject type"
+        with self._lock:
+            self._rules[("write", type, oid, shard)] = _Rule(when, duration)
+        return f"ok: write error type {type} on {oid}"
+
+    def clear_read_error(self, oid: str, type: int, shard: int = ANY_SHARD) -> str:
+        with self._lock:
+            self._rules.pop(("read", type, oid, shard), None)
+        return "ok"
+
+    def clear_write_error(self, oid: str, type: int, shard: int = ANY_SHARD) -> str:
+        with self._lock:
+            self._rules.pop(("write", type, oid, shard), None)
+        return "ok"
+
+    def clear_all(self) -> None:
+        with self._lock:
+            self._rules.clear()
+            self.injected_count = 0
+
+    # -- test hooks (called from the dispatch paths) -------------------
+    def _test(self, kind: str, type: int, oid: str, shard: int) -> bool:
+        with self._lock:
+            for key in (
+                (kind, type, oid, shard),
+                (kind, type, oid, ANY_SHARD),
+            ):
+                rule = self._rules.get(key)
+                if rule is None:
+                    continue
+                fired = rule.fires()
+                if rule.exhausted:
+                    del self._rules[key]
+                if fired:
+                    self.injected_count += 1
+                    return True
+        return False
+
+    def test_read_error0(self, oid: str, shard: int) -> bool:
+        return self._test("read", 0, oid, shard)
+
+    def test_read_error1(self, oid: str, shard: int) -> bool:
+        return self._test("read", 1, oid, shard)
+
+    def test_write_error0(self, oid: str) -> bool:
+        return self._test("write", 0, oid, ANY_SHARD)
+
+    def test_write_error1(self, oid: str, shard: int) -> bool:
+        return self._test("write", 1, oid, shard)
+
+
+# The process-global registry, mirroring the reference's namespace-level
+# singleton state.
+ec_inject = ECInject()

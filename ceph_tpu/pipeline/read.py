@@ -36,11 +36,14 @@ from .stripe import StripeInfo
 
 
 class ShardReadError(Exception):
-    """A shard store failed a sub-read (down OSD / injected EIO)."""
+    """A shard store failed a sub-read. ``kind`` distinguishes an IO
+    error ("eio") from an absent object ("missing", the ENOENT analog
+    of ECInject read type 1) — both retry identically."""
 
-    def __init__(self, shard: int, oid: str = "") -> None:
-        super().__init__(f"shard {shard} read error on {oid!r}")
+    def __init__(self, shard: int, oid: str = "", kind: str = "eio") -> None:
+        super().__init__(f"shard {shard} {kind} on {oid!r}")
         self.shard = shard
+        self.kind = kind
 
 
 @dataclass

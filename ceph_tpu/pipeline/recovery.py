@@ -124,7 +124,8 @@ class RecoveryBackend:
         op.result = ShardExtentMap(self.sinfo)
         op.state = RecoveryState.READING
         if not op.want:
-            return  # nothing stored -> nothing to rebuild
+            return  # no bytes to read; WRITING still restores the
+            # object's existence + attrs on the missing shards
         avail = self.backend.avail_shards() - op.missing
         try:
             op.shard_reads, _ = get_min_avail_to_read_shards(
@@ -202,10 +203,13 @@ class RecoveryBackend:
         op.state = RecoveryState.WRITING
         hinfo = self.hinfo_fn(op.oid)
         hinfo_bytes = hinfo.to_bytes() if hinfo is not None else None
-        op.pending_pushes = set(op.want)
-        for shard, es in op.want.items():
+        # Every missing shard gets a push: zero-length tail shards
+        # still carry the object (touch) and its hinfo attr, exactly
+        # as the original write's per-shard transaction did.
+        op.pending_pushes = set(op.missing)
+        for shard in sorted(op.missing):
             txn = Transaction().touch(op.oid)
-            for start, end in es:
+            for start, end in op.want.get(shard, ExtentSet()):
                 buf = bytes(op.result.get(shard, start, end - start))
                 txn.write(op.oid, start, buf)
                 op.recovered_bytes += len(buf)
@@ -216,8 +220,6 @@ class RecoveryBackend:
                 txn,
                 lambda s=shard, o=op: o.pending_pushes.discard(s),
             )
-        # Missing shards with nothing stored (zero-length tail) still
-        # finish instantly.
         if not op.pending_pushes:
             op.state = RecoveryState.COMPLETE
 

@@ -155,6 +155,7 @@ class ClientOp:
         self.written: "ShardExtentMap | None" = None
         self.committed = False
         self.notified = False
+        self.error: Exception | None = None
 
 
 class ShardBackend:
@@ -189,12 +190,18 @@ class ShardBackend:
         cb: Callable[[int, "dict[int, bytes] | Exception"], None],
     ) -> None:
         """Sub-read fan-out seam (ECSubRead → handle_sub_read). Calls
-        ``cb(shard, {offset: bytes})`` or ``cb(shard, ShardReadError)``."""
+        ``cb(shard, {offset: bytes})`` or ``cb(shard, ShardReadError)``.
+        Consults the ECInject registry the way handle_sub_read does."""
+        from .inject import ec_inject
         from .read import ShardReadError
 
         def run() -> None:
             if shard in self.fail_read_shards or shard in self.down_shards:
                 cb(shard, ShardReadError(shard, oid))
+            elif ec_inject.test_read_error0(oid, shard):
+                cb(shard, ShardReadError(shard, oid, kind="eio"))
+            elif ec_inject.test_read_error1(oid, shard):
+                cb(shard, ShardReadError(shard, oid, kind="missing"))
             else:
                 cb(shard, self.read_shard(shard, oid, extents))
 
@@ -228,6 +235,11 @@ class ShardBackend:
     def submit_shard_txn(
         self, shard: int, txn: Transaction, ack: Callable[[], None]
     ) -> None:
+        from .inject import ec_inject
+
+        oid = txn.oids()[0] if txn.oids() else ""
+        if ec_inject.test_write_error1(oid, shard):
+            return  # sub-write silently dropped: ack never arrives
         self.stores[shard].queue_transactions(txn)
         if self.defer_acks:
             self.deferred.append((shard, ack))
@@ -275,6 +287,16 @@ class RMWPipeline:
         op = ClientOp(self._next_tid, oid, ro_offset, bytes(data), on_commit)
         self._next_tid += 1
         self._inflight[op.tid] = op
+
+        from .inject import ec_inject
+
+        if ec_inject.test_write_error0(oid):
+            # Injected client-write abort (ECInject write type 0): the
+            # op completes in order with an error, nothing dispatches.
+            op.error = IOError(f"injected write error on {oid!r}")
+            op.committed = True
+            self._check_commit_order()
+            return op.tid
 
         object_size = self._object_sizes.get(oid, 0)
         op.plan = plan_write(

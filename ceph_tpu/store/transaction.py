@@ -76,6 +76,14 @@ class Transaction:
         self.ops.extend(other.ops)
         return self
 
+    def oids(self) -> list[str]:
+        """Distinct objects touched, in first-touch order."""
+        seen: list[str] = []
+        for op in self.ops:
+            if op.oid not in seen:
+                seen.append(op.oid)
+        return seen
+
     def empty(self) -> bool:
         return not self.ops
 

@@ -1,0 +1,107 @@
+"""ECInject fault-injection contracts (osd/ECInject.{h,cc} analog):
+when/duration windows, per-shard vs any-shard rules, read types 0/1
+surfacing through the retry path, write type 0 aborting the client op
+in order, write type 1 parking the op with a dropped sub-write ack.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.pipeline.inject import ECInject, ec_inject
+from ceph_tpu.pipeline.read import ReadPipeline
+from ceph_tpu.pipeline.rmw import RMWPipeline, ShardBackend
+from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
+from ceph_tpu.store import MemStore
+
+K, M = 4, 2
+CHUNK = PAGE_SIZE
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    ec_inject.clear_all()
+    yield
+    ec_inject.clear_all()
+
+
+def make_stack():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    codec = registry.factory(
+        "jerasure", {"technique": "reed_sol_van", "k": str(K), "m": str(M)}
+    )
+    backend = ShardBackend({s: MemStore(f"osd.{s}") for s in range(K + M)})
+    rmw = RMWPipeline(sinfo, codec, backend)
+    reads = ReadPipeline(sinfo, codec, backend, rmw.object_size)
+    return rmw, reads, backend
+
+
+class TestRegistry:
+    def test_when_duration_window(self):
+        inj = ECInject()
+        inj.read_error("o", 0, when=2, duration=2)
+        fires = [inj.test_read_error0("o", 0) for _ in range(6)]
+        assert fires == [False, False, True, True, False, False]
+        # rule exhausted and removed
+        assert not inj.test_read_error0("o", 0)
+
+    def test_per_shard_rule(self):
+        inj = ECInject()
+        inj.read_error("o", 0, duration=10, shard=3)
+        assert not inj.test_read_error0("o", 1)
+        assert inj.test_read_error0("o", 3)
+
+    def test_clear(self):
+        inj = ECInject()
+        inj.write_error("o", 1, duration=10)
+        inj.clear_write_error("o", 1)
+        assert not inj.test_write_error1("o", 0)
+
+    def test_unknown_type(self):
+        inj = ECInject()
+        assert "unrecognized" in inj.read_error("o", 9)
+
+
+class TestReadInject:
+    @pytest.mark.parametrize("type,kind", [(0, "eio"), (1, "missing")])
+    def test_read_error_retried(self, rng, type, kind):
+        rmw, reads, backend = make_stack()
+        data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+        rmw.submit("obj", 0, data)
+        ec_inject.read_error("obj", type, duration=1, shard=0)
+        got = {}
+        reads.submit("obj", 0, len(data), lambda op: got.update(op=op))
+        op = got["op"]
+        assert op.error is None and op.data == data
+        assert op.error_shards == {0}
+        assert ec_inject.injected_count == 1
+        # duration exhausted: next read is clean
+        assert reads.read_sync("obj", 0, len(data)) == data
+
+
+class TestWriteInject:
+    def test_write_abort_in_order(self, rng):
+        rmw, reads, backend = make_stack()
+        done = []
+        a = rng.integers(0, 256, CHUNK, np.uint8).tobytes()
+        rmw.submit("obj", 0, a, lambda op: done.append((op.tid, op.error)))
+        ec_inject.write_error("obj", 0, duration=1)
+        rmw.submit("obj", 0, b"Z" * CHUNK, lambda op: done.append((op.tid, op.error)))
+        rmw.submit("obj2", 0, a, lambda op: done.append((op.tid, op.error)))
+        tids = [t for t, _ in done]
+        assert tids == sorted(tids)
+        assert done[0][1] is None
+        assert done[1][1] is not None  # aborted
+        assert done[2][1] is None
+        # aborted write left the object intact
+        assert reads.read_sync("obj", 0, len(a)) == a
+
+    def test_dropped_sub_write_parks_op(self, rng):
+        rmw, reads, backend = make_stack()
+        data = rng.integers(0, 256, CHUNK, np.uint8).tobytes()
+        ec_inject.write_error("obj", 1, duration=1, shard=2)
+        done = []
+        rmw.submit("obj", 0, data, lambda op: done.append(op.tid))
+        assert done == []  # shard 2's ack never arrived
+        tid2 = rmw.submit("obj", CHUNK, data, lambda op: done.append(op.tid))
+        assert done == []  # in-order queue blocks behind the parked op
