@@ -24,6 +24,7 @@ whole window (cached inverted generator rows), not a per-slice call.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from collections.abc import Callable
 from dataclasses import dataclass, field
@@ -275,6 +276,7 @@ class ClientReadOp:
         self.done = False
         self.data: bytes | None = None
         self.error: Exception | None = None
+        self.t_submit: float | None = None
 
 
 class ReadPipeline:
@@ -286,6 +288,7 @@ class ReadPipeline:
         codec,
         backend,
         size_fn: Callable[[str], int],
+        perf_name: str = "ec_read",
     ) -> None:
         self.sinfo = sinfo
         self.codec = codec
@@ -293,6 +296,20 @@ class ReadPipeline:
         self.size_fn = size_fn
         self._next_rid = 1
         self._inflight: "OrderedDict[int, ClientReadOp]" = OrderedDict()
+        from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+        # The io_counters read_cnt/read_bytes analog (ECBackend.cc:
+        # 1797-1823) plus reconstruct/retry visibility.
+        self.perf = (
+            PerfCountersBuilder(perf_collection, perf_name)
+            .add_u64_counter("read_ops", "client reads submitted")
+            .add_u64_counter("read_bytes", "client bytes returned")
+            .add_u64_counter("reconstruct_ops", "reads that decoded")
+            .add_u64_counter("retries", "sub-read retries after errors")
+            .add_u64_counter("errors", "reads failed after retry")
+            .add_avg("read_lat", "submit-to-complete seconds")
+            .create_perf_counters()
+        )
 
     # -- client entry (objects_read_and_reconstruct analog) ------------
     def submit(
@@ -303,8 +320,10 @@ class ReadPipeline:
         on_complete: Callable[[ClientReadOp], None] | None = None,
     ) -> int:
         op = ClientReadOp(self._next_rid, oid, ro_offset, length, on_complete)
+        op.t_submit = time.perf_counter()
         self._next_rid += 1
         self._inflight[op.rid] = op
+        self.perf.inc("read_ops")
 
         # Reads past EOF are trimmed (objects_read_sync semantics).
         size = self.size_fn(oid)
@@ -379,6 +398,7 @@ class ReadPipeline:
         ECCommon.cc:312): issue only byte ranges not already read or
         requested. A still-pending shard can be widened — the extra
         sub-read just bumps its pending count."""
+        self.perf.inc("retries")
         avail = self._avail() - op.error_shards
         try:
             reads, need_decode = get_min_avail_to_read_shards(
@@ -423,14 +443,21 @@ class ReadPipeline:
 
     def _complete(self, op: ClientReadOp) -> None:
         if op.error is None and op.need_decode:
+            from ceph_tpu.utils import tracer
+
+            self.perf.inc("reconstruct_ops")
             try:
-                self._reconstruct(op)
+                with tracer.span("ec_reconstruct", oid=op.oid, rid=op.rid):
+                    self._reconstruct(op)
             except ValueError as e:
                 op.error = e
         if op.error is None:
             op.data = gather_ro_range(
                 self.sinfo, op.result, op.ro_offset, op.length
             )
+            self.perf.inc("read_bytes", len(op.data))
+        else:
+            self.perf.inc("errors")
         self._finish(op)
 
     def _reconstruct(self, op: ClientReadOp) -> None:
@@ -454,5 +481,9 @@ class ReadPipeline:
             if not front.done:
                 return
             self._inflight.pop(rid)
+            if front.t_submit is not None:
+                self.perf.ainc(
+                    "read_lat", time.perf_counter() - front.t_submit
+                )
             if front.on_complete is not None:
                 front.on_complete(front)

@@ -77,12 +77,24 @@ class RecoveryBackend:
         backend,
         size_fn,
         hinfo_fn,
+        perf_name: str = "ec_recovery",
     ) -> None:
         self.sinfo = sinfo
         self.codec = codec
         self.backend = backend
         self.size_fn = size_fn
         self.hinfo_fn = hinfo_fn
+        from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+        self.perf = (
+            PerfCountersBuilder(perf_collection, perf_name)
+            .add_u64_counter("recovery_ops", "objects recovered")
+            .add_u64_counter("recovery_read_bytes",
+                             "survivor bytes read for recovery")
+            .add_u64_counter("recovered_bytes", "bytes pushed to targets")
+            .add_u64_counter("errors", "recoveries failed")
+            .create_perf_counters()
+        )
 
     # -- FSM -------------------------------------------------------------
     def open_recovery_op(self, oid: str, missing: set[int]) -> RecoveryOp:
@@ -104,14 +116,21 @@ class RecoveryBackend:
 
     def recover_object(self, oid: str, missing: set[int]) -> RecoveryOp:
         """Run the FSM to completion (synchronous backend)."""
+        from ceph_tpu.utils import tracer
+
         op = self.open_recovery_op(oid, missing)
-        while op.state is not RecoveryState.COMPLETE:
-            before = op.state
-            self.continue_recovery_op(op)
-            if op.state is before and op.error is not None:
-                break
+        with tracer.span("ec_recover", oid=oid, missing=sorted(missing)):
+            while op.state is not RecoveryState.COMPLETE:
+                before = op.state
+                self.continue_recovery_op(op)
+                if op.state is before and op.error is not None:
+                    break
         if op.error is not None:
+            self.perf.inc("errors")
             raise op.error
+        self.perf.inc("recovery_ops")
+        self.perf.inc("recovery_read_bytes", op.read_bytes)
+        self.perf.inc("recovered_bytes", op.recovered_bytes)
         return op
 
     def _start_reads(self, op: RecoveryOp) -> None:

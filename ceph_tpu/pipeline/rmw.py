@@ -27,6 +27,7 @@ classic OSD's thread pools.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from collections.abc import Callable
 from dataclasses import dataclass, field
@@ -156,6 +157,7 @@ class ClientOp:
         self.committed = False
         self.notified = False
         self.error: Exception | None = None
+        self.t_submit: float | None = None
 
 
 class ShardBackend:
@@ -265,16 +267,33 @@ class RMWPipeline:
         sinfo: StripeInfo,
         codec,
         backend: ShardBackend,
-        cache_lines: int = 1024,
+        cache_lines: int | None = None,
+        perf_name: str = "ec_rmw",
     ) -> None:
         self.sinfo = sinfo
         self.codec = codec
         self.backend = backend
+        if cache_lines is None:
+            from ceph_tpu.utils import config
+
+            cache_lines = config.get("ec_extent_cache_lines")
         self.cache = ECExtentCache(sinfo, self._backend_read, cache_lines)
         self._next_tid = 1
         self._inflight: "OrderedDict[int, ClientOp]" = OrderedDict()
         self._object_sizes: dict[str, int] = {}
         self._hinfo: dict[str, HashInfo] = {}
+        from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+        self.perf = (
+            PerfCountersBuilder(perf_collection, perf_name)
+            .add_u64_counter("write_ops", "client writes submitted")
+            .add_u64_counter("write_bytes", "client bytes written")
+            .add_u64_counter("parity_delta_ops", "writes via parity delta")
+            .add_u64_counter("full_stripe_ops", "writes via full re-encode")
+            .add_u64_counter("aborts", "writes failed before dispatch")
+            .add_avg("commit_lat", "submit-to-commit seconds")
+            .create_perf_counters()
+        )
 
     # -- client entry (ECBackend::submit_transaction analog) -----------
     def submit(
@@ -285,8 +304,11 @@ class RMWPipeline:
         on_commit: Callable[[ClientOp], None] | None = None,
     ) -> int:
         op = ClientOp(self._next_tid, oid, ro_offset, bytes(data), on_commit)
+        op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
+        self.perf.inc("write_ops")
+        self.perf.inc("write_bytes", len(data))
 
         from .inject import ec_inject
 
@@ -295,25 +317,33 @@ class RMWPipeline:
             # op completes in order with an error, nothing dispatches.
             op.error = IOError(f"injected write error on {oid!r}")
             op.committed = True
+            self.perf.inc("aborts")
             self._check_commit_order()
             return op.tid
 
-        object_size = self._object_sizes.get(oid, 0)
-        op.plan = plan_write(
-            self.sinfo,
-            self.codec.get_flags(),
-            ro_offset,
-            len(data),
-            object_size,
-        )
-        op.cache_op = self.cache.prepare(
-            oid,
-            op.plan.to_read,
-            op.plan.to_write,
-            object_size,
-            lambda cop, _op=op: self._cache_ready(_op),
-        )
-        self.cache.execute([op.cache_op])
+        from ceph_tpu.utils import tracer
+
+        with tracer.span("ec_write", oid=oid, tid=op.tid, bytes=len(data)):
+            object_size = self._object_sizes.get(oid, 0)
+            op.plan = plan_write(
+                self.sinfo,
+                self.codec.get_flags(),
+                ro_offset,
+                len(data),
+                object_size,
+            )
+            self.perf.inc(
+                "parity_delta_ops" if op.plan.do_parity_delta
+                else "full_stripe_ops"
+            )
+            op.cache_op = self.cache.prepare(
+                oid,
+                op.plan.to_read,
+                op.plan.to_write,
+                object_size,
+                lambda cop, _op=op: self._cache_ready(_op),
+            )
+            self.cache.execute([op.cache_op])
         return op.tid
 
     def object_size(self, oid: str) -> int:
@@ -439,5 +469,9 @@ class RMWPipeline:
                 return
             self._inflight.pop(tid)
             op.notified = True
+            if op.t_submit is not None:
+                self.perf.ainc(
+                    "commit_lat", time.perf_counter() - op.t_submit
+                )
             if op.on_commit is not None:
                 op.on_commit(op)

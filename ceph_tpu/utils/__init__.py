@@ -2,5 +2,27 @@
 tracing — the ``src/common/`` analog layer."""
 
 from .platform import honor_platform_env
+from .perf_counters import (
+    PerfCounters,
+    PerfCountersBuilder,
+    PerfCountersCollection,
+    perf_collection,
+)
+from .config import ConfigProxy, Option, config
+from .trace import Tracer, tracer
+from .admin_socket import AdminSocket, admin_socket
 
-__all__ = ["honor_platform_env"]
+__all__ = [
+    "honor_platform_env",
+    "PerfCounters",
+    "PerfCountersBuilder",
+    "PerfCountersCollection",
+    "perf_collection",
+    "ConfigProxy",
+    "Option",
+    "config",
+    "Tracer",
+    "tracer",
+    "AdminSocket",
+    "admin_socket",
+]

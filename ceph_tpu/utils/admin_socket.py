@@ -1,0 +1,118 @@
+"""Admin command surface — the admin_socket / ``ceph tell`` analog.
+
+The reference exposes runtime introspection and control through a unix
+socket (common/admin_socket.cc): ``perf dump``, ``config show``/
+``config set``, ``dump_historic_ops``, and the EC error-inject tell
+commands. Here the same registry is an in-process command table (the
+transport is trivial to add; every consumer in-tree is in-process).
+
+Built-in commands are registered at import: perf/config/trace plus the
+ECInject operator surface (the qa suites drive injection exactly this
+way — qa/tasks/ceph_manager.py uses `ceph tell osd.N injectargs`).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections.abc import Callable
+
+
+class AdminSocket:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._commands: dict[str, tuple[Callable[..., object], str]] = {}
+
+    def register(self, command: str, fn: Callable[..., object], desc: str = "") -> None:
+        with self._lock:
+            if command in self._commands:
+                raise ValueError(f"command {command!r} already registered")
+            self._commands[command] = (fn, desc)
+
+    def unregister(self, command: str) -> None:
+        with self._lock:
+            self._commands.pop(command, None)
+
+    def execute(self, command: str, **kwargs):
+        with self._lock:
+            entry = self._commands.get(command)
+        if entry is None:
+            raise KeyError(f"unknown admin command {command!r}")
+        return entry[0](**kwargs)
+
+    def help(self) -> dict[str, str]:
+        with self._lock:
+            return {cmd: desc for cmd, (_, desc) in sorted(self._commands.items())}
+
+
+admin_socket = AdminSocket()
+
+
+def _register_builtins() -> None:
+    from ceph_tpu.utils.config import config
+    from ceph_tpu.utils.perf_counters import perf_collection
+    from ceph_tpu.utils.trace import tracer
+
+    admin_socket.register(
+        "perf dump", lambda: perf_collection.dump(),
+        "dump all perf counters",
+    )
+    admin_socket.register(
+        "config show", lambda: config.show(),
+        "effective config values with their source layer",
+    )
+    admin_socket.register(
+        "config set",
+        lambda name, value: (config.set(name, value), config.get(name))[1],
+        "set a runtime config override",
+    )
+    admin_socket.register(
+        "config get", lambda name: config.get(name),
+        "read one effective config value",
+    )
+    admin_socket.register(
+        "dump_historic_ops",
+        lambda limit=None: tracer.dump_historic(limit),
+        "recently completed trace spans",
+    )
+
+    def _inject(kind: str):
+        from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject
+
+        fn = getattr(ec_inject, kind)
+
+        def run(oid, type, when=0, duration=1, shard=ANY_SHARD):
+            return fn(oid, int(type), when=int(when),
+                      duration=int(duration), shard=int(shard))
+
+        return run
+
+    admin_socket.register(
+        "injectecreaderr", _inject("read_error"),
+        "inject EC read errors (type 0=EIO, 1=missing)",
+    )
+    admin_socket.register(
+        "injectecwriteerr", _inject("write_error"),
+        "inject EC write errors (type 0=abort, 1=dropped sub-write)",
+    )
+
+    def _clear(kind: str):
+        from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject
+
+        fn = getattr(ec_inject, kind)
+
+        def run(oid, type, shard=ANY_SHARD):
+            return fn(oid, int(type), shard=int(shard))
+
+        return run
+
+    admin_socket.register(
+        "injectecclearreaderr", _clear("clear_read_error"),
+        "clear injected EC read errors",
+    )
+    admin_socket.register(
+        "injectecclearwriteerr", _clear("clear_write_error"),
+        "clear injected EC write errors",
+    )
+
+
+_register_builtins()

@@ -1,0 +1,99 @@
+"""Span tracing + historic-op ring — the ZTracer/OpTracker analog.
+
+The reference threads ``ZTracer::Trace`` handles through the EC
+pipeline signatures (osd/ECBackend.h:70-94) and keeps an in-memory
+history of completed ops served as ``dump_historic_ops``
+(common/TrackedOp). Here: a context-manager ``span`` records name,
+parent, wall duration, and tags into a bounded ring; nesting is
+tracked per-thread so pipeline code never passes handles explicitly.
+
+On TPU the same spans also emit ``jax.profiler.TraceAnnotation``
+blocks when profiling is active, so host-side pipeline stages line up
+with device timelines in XLA profile captures.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    span_id: int
+    parent_id: int | None
+    name: str
+    start: float
+    duration: float | None = None
+    tags: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "duration": self.duration,
+            "tags": self.tags,
+        }
+
+
+class Tracer:
+    def __init__(self, history: int = 512, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._ids = itertools.count(1)
+        self._history: deque[Span] = deque(maxlen=history)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _stack(self) -> list[Span]:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        if not self.enabled:
+            yield None
+            return
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(next(self._ids), parent, name, time.time(), tags=tags)
+        stack.append(sp)
+        t0 = time.perf_counter()
+        annotation = None
+        try:
+            import jax.profiler
+
+            annotation = jax.profiler.TraceAnnotation(name)
+            annotation.__enter__()
+        except Exception:
+            annotation = None
+        try:
+            yield sp
+        finally:
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
+            sp.duration = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                self._history.append(sp)
+
+    def dump_historic(self, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._history)
+        if limit is not None:
+            spans = spans[-limit:]
+        return [s.as_dict() for s in spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._history.clear()
+
+
+# Process-global tracer.
+tracer = Tracer()

@@ -1,0 +1,239 @@
+"""Observability layer: typed perf counters + dump schema, layered
+config resolution with observers and validation, span tracing with the
+historic-op ring, and the admin command surface tying them together —
+mirroring the reference's PerfCounters / options.yaml+config /
+ZTracer+OpTracker / admin_socket contracts (SURVEY.md section 5).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.pipeline.read import ReadPipeline
+from ceph_tpu.pipeline.rmw import RMWPipeline, ShardBackend
+from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
+from ceph_tpu.store import MemStore
+from ceph_tpu.utils.admin_socket import admin_socket
+from ceph_tpu.utils.config import ConfigProxy, Option
+from ceph_tpu.utils.perf_counters import (
+    PerfCountersBuilder,
+    PerfCountersCollection,
+)
+from ceph_tpu.utils.trace import Tracer, tracer
+
+
+class TestPerfCounters:
+    def make(self):
+        coll = PerfCountersCollection()
+        pc = (
+            PerfCountersBuilder(coll, "t")
+            .add_u64_counter("ops")
+            .add_u64_gauge("depth")
+            .add_time("busy")
+            .add_avg("lat")
+            .add_histogram("sizes", [100, 1000, 10000])
+            .create_perf_counters()
+        )
+        return coll, pc
+
+    def test_types_and_dump(self):
+        coll, pc = self.make()
+        pc.inc("ops")
+        pc.inc("ops", 4)
+        pc.set("depth", 7)
+        pc.tinc("busy", 0.5)
+        pc.ainc("lat", 0.25)
+        pc.ainc("lat", 0.75)
+        for v in (50, 500, 5000, 50000):
+            pc.hinc("sizes", v)
+        d = coll.dump()["t"]
+        assert d["ops"] == 5
+        assert d["depth"] == 7
+        assert d["busy"] == pytest.approx(0.5)
+        assert d["lat"] == {"avgcount": 2, "sum": 1.0}
+        assert d["sizes"]["counts"] == [1, 1, 1, 1]
+
+    def test_type_misuse_raises(self):
+        _, pc = self.make()
+        with pytest.raises(TypeError):
+            pc.inc("depth")
+        with pytest.raises(KeyError):
+            pc.inc("nope")
+
+    def test_duplicate_key_rejected(self):
+        coll = PerfCountersCollection()
+        b = PerfCountersBuilder(coll, "x").add_u64_counter("a")
+        with pytest.raises(ValueError):
+            b.add_u64_counter("a")
+
+
+class TestConfig:
+    def make(self):
+        opts = (
+            Option("alpha", int, 5, min=1, max=100),
+            Option("mode", str, "fast", enum_values=("fast", "safe")),
+            Option("ratio", float, 0.5),
+        )
+        return ConfigProxy(opts)
+
+    def test_layering(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CEPH_TPU_ALPHA", "30")
+        cfg = ConfigProxy(
+            (Option("alpha", int, 5, min=1, max=100),)
+        )
+        assert cfg.get("alpha") == 30 and cfg.get_source("alpha") == "env"
+        f = tmp_path / "conf.json"
+        f.write_text(json.dumps({"alpha": 20}))
+        cfg.load_file(str(f))
+        # env beats file
+        assert cfg.get("alpha") == 30
+        cfg.set("alpha", 40)  # runtime beats all
+        assert cfg.get("alpha") == 40
+        assert cfg.get_source("alpha") == "runtime"
+        cfg.rm("alpha")
+        assert cfg.get("alpha") == 30
+
+    def test_validation(self):
+        cfg = self.make()
+        with pytest.raises(ValueError):
+            cfg.set("alpha", 1000)
+        with pytest.raises(ValueError):
+            cfg.set("mode", "warp")
+        with pytest.raises(KeyError):
+            cfg.set("ghost", 1)
+        cfg.set("alpha", "42")  # string coercion
+        assert cfg.get("alpha") == 42
+
+    def test_observer(self):
+        cfg = self.make()
+        seen = []
+        cfg.add_observer("alpha", lambda n, v: seen.append((n, v)))
+        cfg.set("alpha", 9)
+        cfg.set("mode", "safe")  # not observed
+        cfg.set("alpha", 9)  # unchanged -> no event
+        assert seen == [("alpha", 9)]
+
+    def test_show(self):
+        cfg = self.make()
+        cfg.set("mode", "safe")
+        show = cfg.show()
+        assert show["mode"] == {"value": "safe", "source": "runtime"}
+        assert show["alpha"] == {"value": 5, "source": "default"}
+
+
+class TestTracer:
+    def test_nesting_and_history(self):
+        t = Tracer(history=10)
+        with t.span("outer", oid="o") as outer:
+            with t.span("inner") as inner:
+                pass
+        hist = t.dump_historic()
+        assert [h["name"] for h in hist] == ["inner", "outer"]
+        assert hist[0]["parent_id"] == outer.span_id
+        assert hist[1]["parent_id"] is None
+        assert all(h["duration"] is not None for h in hist)
+
+    def test_ring_bound(self):
+        t = Tracer(history=3)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.dump_historic()) == 3
+
+    def test_disabled(self):
+        t = Tracer(enabled=False)
+        with t.span("x") as sp:
+            assert sp is None
+        assert t.dump_historic() == []
+
+
+class TestAdminSocket:
+    def test_help_and_builtins(self):
+        cmds = admin_socket.help()
+        for cmd in (
+            "perf dump", "config show", "config set", "dump_historic_ops",
+            "injectecreaderr", "injectecwriteerr",
+        ):
+            assert cmd in cmds
+
+    def test_config_roundtrip(self):
+        assert (
+            admin_socket.execute("config set", name="ec_stripe_batch",
+                                 value="16")
+            == 16
+        )
+        assert admin_socket.execute("config get", name="ec_stripe_batch") == 16
+        from ceph_tpu.utils.config import config
+
+        config.rm("ec_stripe_batch")
+
+    def test_unknown_command(self):
+        with pytest.raises(KeyError):
+            admin_socket.execute("launch missiles")
+
+
+class TestPipelineIntegration:
+    def test_counters_and_spans_flow(self, rng):
+        k, m, chunk = 4, 2, PAGE_SIZE
+        sinfo = StripeInfo(k, m, k * chunk)
+        codec = registry.factory(
+            "jerasure",
+            {"technique": "reed_sol_van", "k": str(k), "m": str(m)},
+        )
+        backend = ShardBackend(
+            {s: MemStore(f"osd.{s}") for s in range(k + m)}
+        )
+        rmw = RMWPipeline(sinfo, codec, backend, perf_name="t_rmw")
+        reads = ReadPipeline(
+            sinfo, codec, backend, rmw.object_size, perf_name="t_read"
+        )
+        tracer.clear()
+        data = rng.integers(0, 256, 2 * k * chunk, np.uint8).tobytes()
+        rmw.submit("obj", 0, data)
+        backend.down_shards.add(1)
+        assert reads.read_sync("obj", 0, len(data)) == data
+
+        dump = admin_socket.execute("perf dump")
+        assert dump["t_rmw"]["write_ops"] == 1
+        assert dump["t_rmw"]["write_bytes"] == len(data)
+        assert dump["t_rmw"]["full_stripe_ops"] == 1
+        assert dump["t_rmw"]["commit_lat"]["avgcount"] == 1
+        assert dump["t_read"]["read_ops"] == 1
+        assert dump["t_read"]["read_bytes"] == len(data)
+        assert dump["t_read"]["reconstruct_ops"] == 1
+        assert dump["t_read"]["errors"] == 0
+
+        names = [
+            s["name"]
+            for s in admin_socket.execute("dump_historic_ops")
+        ]
+        assert "ec_write" in names and "ec_reconstruct" in names
+
+    def test_inject_via_admin_socket(self, rng):
+        from ceph_tpu.pipeline.inject import ec_inject
+
+        ec_inject.clear_all()
+        k, m, chunk = 4, 2, PAGE_SIZE
+        sinfo = StripeInfo(k, m, k * chunk)
+        codec = registry.factory(
+            "jerasure",
+            {"technique": "reed_sol_van", "k": str(k), "m": str(m)},
+        )
+        backend = ShardBackend(
+            {s: MemStore(f"osd.{s}") for s in range(k + m)}
+        )
+        rmw = RMWPipeline(sinfo, codec, backend, perf_name="t2_rmw")
+        reads = ReadPipeline(
+            sinfo, codec, backend, rmw.object_size, perf_name="t2_read"
+        )
+        data = rng.integers(0, 256, k * chunk, np.uint8).tobytes()
+        rmw.submit("obj", 0, data)
+        out = admin_socket.execute(
+            "injectecreaderr", oid="obj", type=0, shard=0
+        )
+        assert "ok" in out
+        assert reads.read_sync("obj", 0, len(data)) == data
+        assert reads.perf.get("retries") == 1
+        ec_inject.clear_all()
