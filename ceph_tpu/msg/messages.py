@@ -1,0 +1,190 @@
+"""Typed, versioned sub-op messages — the MOSDECSubOp* analog.
+
+Mirrors the message vocabulary of the EC fan-out
+(src/messages/MOSDECSubOpWrite.h / MOSDECSubOpRead.h and their
+replies; payload structs osd/ECMsgTypes.{h,cc}): a write carries the
+target shard's transaction (+ the op tid for the in-order commit
+protocol); a read carries per-object extent lists and optional
+sub-chunk selectors; replies carry ack / buffers / per-object errors.
+
+Each message encodes as wire-frame segments: segment 0 is a compact
+header (json — these are tiny), further segments carry bulk bytes
+(transaction payloads, read buffers) so big data is never re-encoded.
+The version byte in the header follows the reference's
+versioned-message pattern (msg/Message.h HEAD_VERSION/COMPAT_VERSION).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ceph_tpu.store import Transaction
+
+# Frame type ids.
+MSG_EC_SUB_WRITE = 108        # MOSDECSubOpWrite
+MSG_EC_SUB_WRITE_REPLY = 109  # MOSDECSubOpWriteReply
+MSG_EC_SUB_READ = 110         # MOSDECSubOpRead
+MSG_EC_SUB_READ_REPLY = 111   # MOSDECSubOpReadReply
+
+VERSION = 1
+
+
+def _header(kind: str, fields: dict) -> bytes:
+    return json.dumps({"v": VERSION, "kind": kind, **fields}).encode()
+
+
+def _parse(seg: bytes, kind: str) -> dict:
+    obj = json.loads(seg.decode())
+    if obj.get("v", 0) > VERSION:
+        raise ValueError(f"{kind} from the future: v{obj['v']}")
+    if obj.get("kind") != kind:
+        raise ValueError(f"expected {kind}, got {obj.get('kind')!r}")
+    return obj
+
+
+@dataclass
+class ECSubWrite:
+    """Per-shard write sub-op (ECSubWrite, osd/ECMsgTypes.h)."""
+
+    tid: int
+    shard: int
+    txn: Transaction
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header("sub_write", {"tid": self.tid, "shard": self.shard}),
+            self.txn.to_bytes(),
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "ECSubWrite":
+        h = _parse(segments[0], "sub_write")
+        return cls(h["tid"], h["shard"], Transaction.from_bytes(segments[1]))
+
+
+@dataclass
+class ECSubWriteReply:
+    tid: int
+    shard: int
+    committed: bool = True
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "sub_write_reply",
+                {"tid": self.tid, "shard": self.shard,
+                 "committed": self.committed},
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "ECSubWriteReply":
+        h = _parse(segments[0], "sub_write_reply")
+        return cls(h["tid"], h["shard"], h["committed"])
+
+
+@dataclass
+class ECSubRead:
+    """Per-shard read sub-op: oid -> extent list (+ sub-chunk
+    selectors, the CLAY plumbing of ECCommon.h:85)."""
+
+    tid: int
+    shard: int
+    oid: str
+    extents: list[tuple[int, int]]  # (start, end) pairs
+    subchunks: list[tuple[int, int]] | None = None
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "sub_read",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "oid": self.oid,
+                    "extents": self.extents,
+                    "subchunks": self.subchunks,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "ECSubRead":
+        h = _parse(segments[0], "sub_read")
+        sub = h["subchunks"]
+        return cls(
+            h["tid"],
+            h["shard"],
+            h["oid"],
+            [tuple(e) for e in h["extents"]],
+            [tuple(s) for s in sub] if sub is not None else None,
+        )
+
+
+@dataclass
+class ECSubReadReply:
+    """Buffers (offset-keyed) or an error for one sub-read."""
+
+    tid: int
+    shard: int
+    offsets: list[int] = field(default_factory=list)
+    buffers: list[bytes] = field(default_factory=list)
+    error: str = ""  # "" | "eio" | "missing"
+
+    def encode(self) -> list[bytes]:
+        segs = [
+            _header(
+                "sub_read_reply",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "offsets": self.offsets,
+                    "error": self.error,
+                },
+            )
+        ]
+        # One bulk segment: per-segment crc covers all buffers; the
+        # header's offsets + lengths let the receiver re-split.
+        segs.append(
+            json.dumps([len(b) for b in self.buffers]).encode()
+        )
+        segs.append(b"".join(self.buffers))
+        return segs
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "ECSubReadReply":
+        h = _parse(segments[0], "sub_read_reply")
+        lengths = json.loads(segments[1].decode())
+        blob = segments[2]
+        buffers, pos = [], 0
+        for ln in lengths:
+            buffers.append(blob[pos : pos + ln])
+            pos += ln
+        return cls(h["tid"], h["shard"], h["offsets"], buffers, h["error"])
+
+
+_DECODERS = {
+    MSG_EC_SUB_WRITE: ECSubWrite.decode,
+    MSG_EC_SUB_WRITE_REPLY: ECSubWriteReply.decode,
+    MSG_EC_SUB_READ: ECSubRead.decode,
+    MSG_EC_SUB_READ_REPLY: ECSubReadReply.decode,
+}
+
+_TYPE_OF = {
+    ECSubWrite: MSG_EC_SUB_WRITE,
+    ECSubWriteReply: MSG_EC_SUB_WRITE_REPLY,
+    ECSubRead: MSG_EC_SUB_READ,
+    ECSubReadReply: MSG_EC_SUB_READ_REPLY,
+}
+
+
+def message_type(msg) -> int:
+    return _TYPE_OF[type(msg)]
+
+
+def decode_message(msg_type: int, segments: list[bytes]):
+    dec = _DECODERS.get(msg_type)
+    if dec is None:
+        raise ValueError(f"unknown message type {msg_type}")
+    return dec(segments)

@@ -1,0 +1,174 @@
+"""Socket messenger — the AsyncMessenger analog (host/DCN tier).
+
+Mirrors the roles of msg/async/AsyncMessenger.{h,cc}: a ``Messenger``
+binds a listening address and dispatches inbound typed messages to its
+dispatcher (the ``ms_fast_dispatch`` seam, osd/OSD.cc:7686);
+``Connection`` objects carry framed messages (wire.py) over TCP with a
+reader thread per connection. Event-loop sophistication (epoll worker
+pools, lossy/lossless policies with replay) is intentionally replaced
+by one thread per connection — connection counts here are k+m, not
+thousands; the wire format, per-segment CRC, and dispatch contract are
+the load-bearing parts.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from collections.abc import Callable
+
+from .messages import decode_message, message_type
+from .wire import decode_frame, encode_frame
+
+
+class Connection:
+    """One peer link; ``send(msg)`` frames and writes atomically."""
+
+    def __init__(self, sock: socket.socket, messenger: "Messenger") -> None:
+        self.sock = sock
+        self.messenger = messenger
+        self._send_lock = threading.Lock()
+        self._seq = 0
+        self.alive = True
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def send(self, msg) -> None:
+        frame = encode_frame(message_type(msg), self._next_seq(), msg.encode())
+        with self._send_lock:
+            try:
+                self.sock.sendall(frame)
+            except OSError as e:
+                self.alive = False
+                raise ConnectionError(str(e)) from e
+
+    def _next_seq(self) -> int:
+        with self._send_lock:
+            self._seq += 1
+            return self._seq
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg_type, _seq, segments = decode_frame(self._read_exact)
+                msg = decode_message(msg_type, segments)
+                self.messenger.dispatch(self, msg)
+        except (EOFError, OSError):
+            pass
+        except Exception:
+            # Decode/dispatch failure (bad frame, unknown type, handler
+            # bug): drop the connection loudly-at-the-socket so the
+            # peer sees EOF and fails fast instead of waiting out RPC
+            # timeouts on a wedged link.
+            pass
+        finally:
+            self.alive = False
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.messenger._conn_closed(self)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class Messenger:
+    """Bind/connect endpoint + dispatcher registry."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.dispatcher: Callable[[Connection, object], None] | None = None
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._stopping = False
+        self._conns: set[Connection] = set()
+        self._lock = threading.Lock()
+        self.addr: tuple[str, int] | None = None
+
+    def set_dispatcher(self, fn: Callable[[Connection, object], None]) -> None:
+        self.dispatcher = fn
+
+    def dispatch(self, conn: Connection, msg) -> None:
+        if self.dispatcher is not None:
+            self.dispatcher(conn, msg)
+
+    # -- server side ---------------------------------------------------
+    def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.listen(64)
+        # Poll with a timeout: closing a listener out from under a
+        # thread blocked in accept() does NOT close the kernel-side
+        # open file description — the old accept keeps serving the
+        # port. The flag + timeout loop is the portable shutdown.
+        s.settimeout(0.2)
+        self._stopping = False
+        self._listener = s
+        self.addr = s.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+        return self.addr
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                sock, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(Connection(sock, self))
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- client side ---------------------------------------------------
+    def connect(self, addr: tuple[str, int]) -> Connection:
+        sock = socket.create_connection(addr, timeout=10)
+        if sock.getsockname() == sock.getpeername():
+            # TCP self-connect: the kernel picked the (freed) target
+            # port as our ephemeral source port — the peer is gone.
+            sock.close()
+            raise ConnectionError(f"self-connect to dead peer {addr}")
+        sock.settimeout(None)  # connect timeout must not become a
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)  # recv timeout
+        conn = Connection(sock, self)
+        with self._lock:
+            self._conns.add(conn)
+        return conn
+
+    def _conn_closed(self, conn: Connection) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def shutdown(self) -> None:
+        self._stopping = True
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=1.0)
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            conn.close()

@@ -1,0 +1,274 @@
+"""Shard daemon + networked shard backend — the mini-OSD tier.
+
+``ShardServer`` is the remote end of the EC fan-out: it owns one
+shard's store and serves ECSubWrite/ECSubRead exactly like the
+reference's ``handle_sub_write``/``handle_sub_read``
+(osd/ECBackend.cc:912,998) by delegating to the same local
+``ShardBackend`` the in-process pipelines use (one source of truth for
+zero-padding and ECInject consultation), over the framed wire protocol.
+
+``NetShardBackend`` is a drop-in for ``pipeline.rmw.ShardBackend``
+whose sub-ops travel over sockets. Sub-op sends are asynchronous (the
+whole k+m fan-out goes out before any reply is awaited — one RTT per
+op, not per shard); replies are queued and executed on the CALLER's
+thread via ``drain_until``, so pipeline state stays single-threaded
+(the crimson run-to-completion stance, not reader-thread reentrancy).
+RPC timeouts and connection failures mark the shard down (the
+failure-detection seam), so degraded reads and recovery route around a
+dead daemon automatically; a lost sub-write ack parks its op exactly
+like the reference until recovery intervenes.
+
+Deep scrub currently requires local stores (it reads attrs directly);
+a getattr sub-op is the natural extension point.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections.abc import Callable
+
+from ceph_tpu.store import MemStore, Transaction
+
+from .messages import (
+    ECSubRead,
+    ECSubReadReply,
+    ECSubWrite,
+    ECSubWriteReply,
+)
+from .messenger import Connection, Messenger
+
+
+class ShardServer:
+    """One shard's daemon: store + messenger + sub-op handlers."""
+
+    def __init__(self, shard: int, store: MemStore | None = None) -> None:
+        from ceph_tpu.pipeline.rmw import ShardBackend
+
+        self.shard = shard
+        self.store = store or MemStore(f"osd.{shard}")
+        # Delegate sub-op semantics (zero-pad reads, inject hooks) to
+        # the same backend the in-process pipelines use.
+        self._local = ShardBackend({shard: self.store})
+        self.messenger = Messenger(f"osd.{shard}")
+        self.messenger.set_dispatcher(self._dispatch)
+        self.addr: tuple[str, int] | None = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self.addr = self.messenger.bind(host, port)
+        return self.addr
+
+    def stop(self) -> None:
+        self.messenger.shutdown()
+
+    # -- sub-op handlers (handle_sub_write / handle_sub_read) ----------
+    def _dispatch(self, conn: Connection, msg) -> None:
+        if isinstance(msg, ECSubWrite):
+            self._local.submit_shard_txn(
+                self.shard,
+                msg.txn,
+                lambda: conn.send(ECSubWriteReply(msg.tid, self.shard)),
+            )
+        elif isinstance(msg, ECSubRead):
+            from ceph_tpu.pipeline.extents import ExtentSet
+
+            def reply(shard: int, result) -> None:
+                if isinstance(result, Exception):
+                    kind = getattr(result, "kind", "eio")
+                    conn.send(
+                        ECSubReadReply(msg.tid, shard, error=kind)
+                    )
+                else:
+                    offsets = sorted(result)
+                    conn.send(
+                        ECSubReadReply(
+                            msg.tid,
+                            shard,
+                            offsets,
+                            [bytes(result[o]) for o in offsets],
+                        )
+                    )
+
+            self._local.read_shard_async(
+                self.shard,
+                msg.oid,
+                ExtentSet((s, e) for s, e in msg.extents),
+                reply,
+            )
+
+
+class _Pending:
+    __slots__ = ("shard", "oid", "on_reply", "deadline", "is_read")
+
+    def __init__(self, shard, oid, on_reply, deadline, is_read):
+        self.shard = shard
+        self.oid = oid
+        self.on_reply = on_reply
+        self.deadline = deadline
+        self.is_read = is_read
+
+
+class NetShardBackend:
+    """ShardBackend over the wire: same surface the pipelines consume
+    (avail_shards / read_shard / read_shard_async / submit_shard_txn).
+
+    Callbacks are NEVER invoked from reader threads: replies queue into
+    an inbox that ``drain_until`` executes on the calling thread.
+    """
+
+    def __init__(
+        self, addrs: dict[int, tuple[str, int]], timeout: float = 10.0
+    ) -> None:
+        self.addrs = dict(addrs)
+        self.timeout = timeout
+        self.down_shards: set[int] = set()
+        self.messenger = Messenger("client")
+        self.messenger.set_dispatcher(self._dispatch)
+        self._conns: dict[int, Connection] = {}
+        self._tids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._waiting: dict[tuple[int, int], _Pending] = {}
+        self._inbox: "queue.Queue[Callable[[], None]]" = queue.Queue()
+
+    # -- plumbing ------------------------------------------------------
+    def _conn(self, shard: int) -> Connection:
+        with self._lock:
+            conn = self._conns.get(shard)
+        if conn is not None and conn.alive:
+            return conn
+        conn = self.messenger.connect(self.addrs[shard])
+        with self._lock:
+            self._conns[shard] = conn
+        return conn
+
+    def _dispatch(self, conn: Connection, msg) -> None:
+        """Reader thread: queue the reply for the caller to drain."""
+        if not isinstance(msg, (ECSubWriteReply, ECSubReadReply)):
+            return  # a reflected request must never satisfy an RPC
+        with self._lock:
+            entry = self._waiting.pop((msg.tid, msg.shard), None)
+        if entry is not None:
+            self._inbox.put(lambda: entry.on_reply(msg))
+
+    def _register(self, tid, shard, oid, on_reply, is_read) -> None:
+        with self._lock:
+            self._waiting[(tid, shard)] = _Pending(
+                shard, oid, on_reply, time.monotonic() + self.timeout,
+                is_read,
+            )
+
+    def _send(self, shard: int, msg, tid: int) -> bool:
+        try:
+            self._conn(shard).send(msg)
+            return True
+        except (ConnectionError, OSError, KeyError):
+            with self._lock:
+                self._waiting.pop((tid, shard), None)
+            self.down_shards.add(shard)
+            return False
+
+    def _expire(self) -> None:
+        """Timed-out RPCs: mark the shard down; reads get an error
+        callback, writes stay parked (lost-ack semantics)."""
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for key, entry in list(self._waiting.items()):
+                if entry.deadline <= now:
+                    expired.append((key, entry))
+                    del self._waiting[key]
+        for (tid, shard), entry in expired:
+            self.down_shards.add(shard)
+            if entry.is_read:
+                from ceph_tpu.pipeline.read import ShardReadError
+
+                self._inbox.put(
+                    lambda e=entry: e.on_reply(
+                        ShardReadError(e.shard, e.oid)
+                    )
+                )
+
+    # -- caller-thread event loop --------------------------------------
+    def drain_until(
+        self, pred: Callable[[], bool], timeout: float = 30.0
+    ) -> None:
+        """Run queued reply callbacks on this thread until ``pred``
+        holds. Raises TimeoutError if it never does."""
+        end = time.monotonic() + timeout
+        while not pred():
+            self._expire()
+            try:
+                thunk = self._inbox.get(timeout=0.05)
+            except queue.Empty:
+                if time.monotonic() > end:
+                    raise TimeoutError("drain_until: condition never held")
+                continue
+            thunk()
+
+    # -- ShardBackend surface ------------------------------------------
+    def set_addr(self, shard: int, addr: tuple[str, int]) -> None:
+        """Point a shard at a replacement daemon and mark it up (the
+        osdmap-update analog after an OSD is replaced)."""
+        with self._lock:
+            self.addrs[shard] = addr
+            conn = self._conns.pop(shard, None)
+        if conn is not None:
+            conn.close()
+        self.down_shards.discard(shard)
+
+    def avail_shards(self) -> set[int]:
+        return set(self.addrs) - self.down_shards
+
+    def read_shard_async(
+        self,
+        shard: int,
+        oid: str,
+        extents,
+        cb: Callable[[int, object], None],
+    ) -> None:
+        from ceph_tpu.pipeline.read import ShardReadError
+
+        tid = next(self._tids)
+
+        def on_reply(reply) -> None:
+            if isinstance(reply, Exception):
+                cb(shard, reply)
+            elif reply.error:
+                cb(shard, ShardReadError(shard, oid, kind=reply.error))
+            else:
+                cb(shard, dict(zip(reply.offsets, reply.buffers)))
+
+        self._register(tid, shard, oid, on_reply, is_read=True)
+        msg = ECSubRead(tid, shard, oid, [(s, e) for s, e in extents])
+        if not self._send(shard, msg, tid):
+            self._inbox.put(lambda: cb(shard, ShardReadError(shard, oid)))
+
+    def read_shard(self, shard: int, oid: str, extents) -> dict[int, bytes]:
+        """Synchronous single-shard read (drains inline)."""
+        out: dict[str, object] = {}
+        self.read_shard_async(
+            shard, oid, extents, lambda s, r: out.update(r=r)
+        )
+        self.drain_until(lambda: "r" in out, timeout=self.timeout + 5)
+        result = out["r"]
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def submit_shard_txn(
+        self, shard: int, txn: Transaction, ack: Callable[[], None]
+    ) -> None:
+        tid = next(self._tids)
+
+        def on_reply(reply) -> None:
+            if not isinstance(reply, Exception) and reply.committed:
+                ack()
+            # else parked: ack never fires, recovery's problem
+
+        self._register(tid, shard, "", on_reply, is_read=False)
+        self._send(shard, ECSubWrite(tid, shard, txn), tid)
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()

@@ -1,0 +1,88 @@
+"""Framed wire protocol with per-segment CRC — the ProtocolV2 analog.
+
+Mirrors the frame shape of msg/async/frames_v2.{h,cc}: a fixed header
+(magic, message type, sequence, segment count) followed by a segment
+table (length + crc32c per segment) and the segment payloads. Every
+segment's crc32c is verified on decode — a flipped bit anywhere raises
+``BadFrame``, the on-wire integrity contract ProtocolV2 provides
+(SURVEY.md section 5.8; the reference seeds crc32c with -1).
+
+AES-GCM secure mode and on-wire compression are out of scope for now;
+the header reserves a flags byte for both.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ceph_tpu.checksum.reference import crc32c_ref
+
+MAGIC = b"CTv2"
+_HDR = struct.Struct("<4sHBBQ")  # magic, type, flags, nseg, seq
+_SEG = struct.Struct("<II")      # length, crc32c
+CRC_SEED = 0xFFFFFFFF
+
+MAX_SEGMENTS = 8
+MAX_SEGMENT_BYTES = 1 << 30
+
+
+class BadFrame(Exception):
+    pass
+
+
+def _crc(data: bytes) -> int:
+    return crc32c_ref(CRC_SEED, data)
+
+
+def encode_frame(msg_type: int, seq: int, segments: list[bytes]) -> bytes:
+    if not 0 < len(segments) <= MAX_SEGMENTS:
+        raise ValueError(f"1..{MAX_SEGMENTS} segments, got {len(segments)}")
+    out = bytearray(_HDR.pack(MAGIC, msg_type, 0, len(segments), seq))
+    for seg in segments:
+        out += _SEG.pack(len(seg), _crc(seg))
+    for seg in segments:
+        out += seg
+    return bytes(out)
+
+
+def decode_frame(read_exact) -> tuple[int, int, list[bytes]]:
+    """Parse one frame from ``read_exact(n) -> bytes`` (raises
+    ``EOFError`` at stream end). Returns (msg_type, seq, segments)."""
+    hdr = read_exact(_HDR.size)
+    magic, msg_type, flags, nseg, seq = _HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise BadFrame(f"bad magic {magic!r}")
+    if flags != 0:
+        raise BadFrame(f"unsupported flags {flags:#x}")
+    if not 0 < nseg <= MAX_SEGMENTS:
+        raise BadFrame(f"bad segment count {nseg}")
+    table = []
+    for _ in range(nseg):
+        length, crc = _SEG.unpack(read_exact(_SEG.size))
+        if length > MAX_SEGMENT_BYTES:
+            raise BadFrame(f"segment too large: {length}")
+        table.append((length, crc))
+    segments = []
+    for length, crc in table:
+        seg = read_exact(length)
+        if _crc(seg) != crc:
+            raise BadFrame(
+                f"segment crc mismatch: got {_crc(seg):#x} want {crc:#x}"
+            )
+        segments.append(seg)
+    return msg_type, seq, segments
+
+
+def frame_from_buffer(buf: bytes) -> tuple[int, int, list[bytes]]:
+    """Decode a frame held fully in memory (tests / datagram use)."""
+    pos = 0
+
+    def read_exact(n: int) -> bytes:
+        nonlocal pos
+        if pos + n > len(buf):
+            raise EOFError
+        out = buf[pos : pos + n]
+        pos += n
+        return out
+
+    return decode_frame(read_exact)

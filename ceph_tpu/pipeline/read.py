@@ -352,10 +352,14 @@ class ReadPipeline:
         return op.rid
 
     def read_sync(self, oid: str, ro_offset: int, length: int) -> bytes:
-        """Synchronous wrapper (ECBackend::objects_read_sync analog) —
-        valid only with a non-deferring backend."""
+        """Synchronous wrapper (ECBackend::objects_read_sync analog).
+        Backends with a ``drain_until`` event loop (the networked one)
+        are drained on this thread until the read completes."""
         out: dict[str, ClientReadOp] = {}
         self.submit(oid, ro_offset, length, lambda op: out.update(op=op))
+        drain = getattr(self.backend, "drain_until", None)
+        if drain is not None and "op" not in out:
+            drain(lambda: "op" in out)
         op = out["op"]
         if op.error is not None:
             raise op.error

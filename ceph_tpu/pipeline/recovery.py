@@ -115,9 +115,11 @@ class RecoveryBackend:
         return op.state
 
     def recover_object(self, oid: str, missing: set[int]) -> RecoveryOp:
-        """Run the FSM to completion (synchronous backend)."""
+        """Run the FSM to completion. Backends with a ``drain_until``
+        event loop (the networked one) are drained between states."""
         from ceph_tpu.utils import tracer
 
+        drain = getattr(self.backend, "drain_until", None)
         op = self.open_recovery_op(oid, missing)
         with tracer.span("ec_recover", oid=oid, missing=sorted(missing)):
             while op.state is not RecoveryState.COMPLETE:
@@ -125,6 +127,15 @@ class RecoveryBackend:
                 self.continue_recovery_op(op)
                 if op.state is before and op.error is not None:
                     break
+                if op.state is before:
+                    if drain is not None and op.pending_reads:
+                        drain(lambda: not op.pending_reads or op.error)
+                    elif drain is not None and op.pending_pushes:
+                        drain(lambda: not op.pending_pushes)
+                    else:
+                        raise RuntimeError(
+                            f"recovery stalled in {op.state} for {oid!r}"
+                        )
         if op.error is not None:
             self.perf.inc("errors")
             raise op.error

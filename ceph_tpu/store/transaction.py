@@ -76,6 +76,71 @@ class Transaction:
         self.ops.extend(other.ops)
         return self
 
+    # -- wire serialization (Transaction::encode/decode analog) --------
+    _KIND_CODE = {k: i for i, k in enumerate(OpKind)}
+
+    def to_bytes(self) -> bytes:
+        """Compact binary encoding for ECSubWrite payloads: version
+        byte, op count, then per op kind/oid/offset/length/name/data
+        with u32 length prefixes (the versioned encode/decode pattern
+        of src/os/Transaction.h)."""
+        import struct
+
+        out = bytearray()
+        out += struct.pack("<BI", 1, len(self.ops))
+        for op in self.ops:
+            oid = op.oid.encode()
+            name = op.name.encode()
+            out += struct.pack(
+                "<BI", self._KIND_CODE[op.kind], len(oid)
+            )
+            out += oid
+            out += struct.pack("<QQI", op.offset, op.length, len(name))
+            out += name
+            out += struct.pack("<I", len(op.data))
+            out += op.data
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Transaction":
+        import struct
+
+        pos = 0
+
+        def take(n: int) -> bytes:
+            nonlocal pos
+            if pos + n > len(raw):
+                raise ValueError(
+                    f"truncated transaction encoding at byte {pos}+{n}"
+                )
+            out = raw[pos : pos + n]
+            pos += n
+            return out
+
+        kinds = list(OpKind)
+        ver, count = struct.unpack("<BI", take(5))
+        if ver != 1:
+            raise ValueError(f"unsupported transaction encoding v{ver}")
+        txn = cls()
+        for _ in range(count):
+            code, oid_len = struct.unpack("<BI", take(5))
+            if code >= len(kinds):
+                raise ValueError(f"unknown op kind code {code}")
+            oid = take(oid_len).decode()
+            offset, length, name_len = struct.unpack("<QQI", take(20))
+            name = take(name_len).decode()
+            (data_len,) = struct.unpack("<I", take(4))
+            data = bytes(take(data_len))
+            txn.ops.append(
+                Op(kinds[code], oid, offset=offset, length=length,
+                   data=data, name=name)
+            )
+        if pos != len(raw):
+            raise ValueError(
+                f"{len(raw) - pos} trailing bytes after transaction ops"
+            )
+        return txn
+
     def oids(self) -> list[str]:
         """Distinct objects touched, in first-touch order."""
         seen: list[str] = []

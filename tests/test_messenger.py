@@ -1,0 +1,220 @@
+"""Wire protocol + messenger + networked shard backend.
+
+Contracts mirrored from the reference: ProtocolV2-style framing with
+per-segment crc32c catching any on-wire corruption (msg/async/
+frames_v2), versioned typed sub-op messages (MOSDECSubOp*), and the
+standalone-cluster tier: real shard daemons on localhost sockets
+serving the unchanged RMW/read/recovery pipelines
+(qa/standalone/erasure-code boots exactly this topology).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.msg import (
+    BadFrame,
+    ECSubRead,
+    ECSubReadReply,
+    ECSubWrite,
+    ECSubWriteReply,
+    NetShardBackend,
+    ShardServer,
+    decode_message,
+    encode_frame,
+)
+from ceph_tpu.msg.messages import message_type
+from ceph_tpu.msg.wire import frame_from_buffer
+from ceph_tpu.pipeline.inject import ec_inject
+from ceph_tpu.pipeline.read import ReadPipeline
+from ceph_tpu.pipeline.recovery import RecoveryBackend
+from ceph_tpu.pipeline.rmw import RMWPipeline
+from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
+from ceph_tpu.store import MemStore, Transaction
+
+K, M = 4, 2
+CHUNK = PAGE_SIZE
+
+
+@pytest.fixture(autouse=True)
+def clean_inject():
+    ec_inject.clear_all()
+    yield
+    ec_inject.clear_all()
+
+
+class TestWire:
+    def test_round_trip(self):
+        segs = [b"header-ish", b"x" * 10000, b""]
+        buf = encode_frame(7, 42, segs)
+        msg_type, seq, out = frame_from_buffer(buf)
+        assert (msg_type, seq, out) == (7, 42, segs)
+
+    def test_corruption_detected(self):
+        buf = bytearray(encode_frame(7, 1, [b"payload-bytes" * 100]))
+        buf[-5] ^= 0x01  # flip one payload bit
+        with pytest.raises(BadFrame, match="crc"):
+            frame_from_buffer(bytes(buf))
+
+    def test_bad_magic(self):
+        buf = bytearray(encode_frame(7, 1, [b"x"]))
+        buf[0] ^= 0xFF
+        with pytest.raises(BadFrame, match="magic"):
+            frame_from_buffer(bytes(buf))
+
+
+class TestTransactionCodec:
+    def test_round_trip(self):
+        txn = (
+            Transaction()
+            .touch("o")
+            .write("o", 4096, b"\x00\x01\x02" * 100)
+            .zero("o", 0, 512)
+            .truncate("o", 9999)
+            .setattr("o", "hinfo_key", b"{}")
+            .rmattr("o", "junk")
+            .remove("gone")
+        )
+        back = Transaction.from_bytes(txn.to_bytes())
+        assert [
+            (op.kind, op.oid, op.offset, op.length, op.data, op.name)
+            for op in back.ops
+        ] == [
+            (op.kind, op.oid, op.offset, op.length, op.data, op.name)
+            for op in txn.ops
+        ]
+
+
+class TestMessages:
+    def test_all_types_round_trip(self):
+        msgs = [
+            ECSubWrite(5, 2, Transaction().write("o", 0, b"abc")),
+            ECSubWriteReply(5, 2, committed=True),
+            ECSubRead(6, 1, "o", [(0, 4096), (8192, 12288)], [(0, 4)]),
+            ECSubReadReply(6, 1, [0, 8192], [b"a" * 10, b"b" * 20]),
+            ECSubReadReply(7, 3, error="eio"),
+        ]
+        for msg in msgs:
+            buf = encode_frame(message_type(msg), 1, msg.encode())
+            msg_type, _seq, segs = frame_from_buffer(buf)
+            back = decode_message(msg_type, segs)
+            assert type(back) is type(msg)
+            if isinstance(msg, ECSubWrite):
+                assert back.txn.to_bytes() == msg.txn.to_bytes()
+                assert (back.tid, back.shard) == (msg.tid, msg.shard)
+            else:
+                assert back == msg
+
+
+def boot_cluster(n=K + M, timeout=3.0):
+    servers = {s: ShardServer(s) for s in range(n)}
+    addrs = {s: srv.start() for s, srv in servers.items()}
+    backend = NetShardBackend(addrs, timeout=timeout)
+    return servers, backend
+
+
+class TestShardServer:
+    def test_write_then_read(self, rng):
+        servers, backend = boot_cluster(1)
+        try:
+            payload = rng.integers(0, 256, 10000, np.uint8).tobytes()
+            acked = []
+            backend.submit_shard_txn(
+                0,
+                Transaction().write("o", 0, payload),
+                lambda: acked.append(True),
+            )
+            backend.drain_until(lambda: acked)
+            assert acked == [True]
+            from ceph_tpu.pipeline.extents import ExtentSet
+
+            out = backend.read_shard(0, "o", ExtentSet([(0, 10000)]))
+            assert out[0] == payload
+            # absent tail zero-pads, absent object reads as zeros
+            out = backend.read_shard(0, "ghost", ExtentSet([(0, 16)]))
+            assert out[0] == b"\0" * 16
+        finally:
+            backend.shutdown()
+            for srv in servers.values():
+                srv.stop()
+
+
+class TestDistributedPipeline:
+    def make(self, timeout=3.0):
+        servers, backend = boot_cluster(timeout=timeout)
+        sinfo = StripeInfo(K, M, K * CHUNK)
+        codec = registry.factory(
+            "jerasure",
+            {"technique": "reed_sol_van", "k": str(K), "m": str(M)},
+        )
+        rmw = RMWPipeline(sinfo, codec, backend, perf_name="net_rmw")
+        reads = ReadPipeline(
+            sinfo, codec, backend, rmw.object_size, perf_name="net_read"
+        )
+        return servers, backend, sinfo, codec, rmw, reads
+
+    def teardown_cluster(self, servers, backend):
+        backend.shutdown()
+        for srv in servers.values():
+            srv.stop()
+
+    @staticmethod
+    def net_write(rmw, backend, oid, offset, data):
+        """Submit + drain: sub-write acks arrive via the event loop."""
+        done = []
+        rmw.submit(oid, offset, data, lambda op: done.append(op.tid))
+        backend.drain_until(lambda: done)
+        return done
+
+    def test_write_read_over_sockets(self, rng):
+        servers, backend, sinfo, codec, rmw, reads = self.make()
+        try:
+            data = rng.integers(
+                0, 256, 3 * K * CHUNK + 501, np.uint8
+            ).tobytes()
+            done = self.net_write(rmw, backend, "obj", 0, data)
+            assert done == [1]  # all k+m sub-writes acked over the wire
+            assert reads.read_sync("obj", 0, len(data)) == data
+            # the shard stores really hold the data remotely
+            assert servers[0].store.exists("obj")
+        finally:
+            self.teardown_cluster(servers, backend)
+
+    def test_daemon_death_degraded_read_and_recovery(self, rng):
+        servers, backend, sinfo, codec, rmw, reads = self.make()
+        try:
+            data = rng.integers(0, 256, 2 * K * CHUNK, np.uint8).tobytes()
+            self.net_write(rmw, backend, "obj", 0, data)
+            # Kill shard 1's daemon: first read discovers the failure,
+            # marks it down, and reconstructs.
+            old_store = servers[1].store
+            servers[1].stop()
+            assert reads.read_sync("obj", 0, len(data)) == data
+            assert 1 in backend.down_shards
+
+            # Replacement daemon on a new port; backfill over the wire.
+            replacement = ShardServer(1, MemStore("osd.1.reborn"))
+            backend.set_addr(1, replacement.start())
+            rec = RecoveryBackend(
+                sinfo, codec, backend, rmw.object_size, rmw.hinfo,
+                perf_name="net_recovery",
+            )
+            rec.recover_object("obj", {1})
+            assert replacement.store.read("obj") == old_store.read("obj")
+            # And the recovered shard serves reads with another down.
+            servers[0].stop()
+            assert reads.read_sync("obj", 0, len(data)) == data
+            replacement.stop()
+        finally:
+            self.teardown_cluster(servers, backend)
+
+    def test_inject_eio_server_side(self, rng):
+        servers, backend, sinfo, codec, rmw, reads = self.make()
+        try:
+            data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+            self.net_write(rmw, backend, "obj", 0, data)
+            ec_inject.read_error("obj", 0, duration=1, shard=2)
+            assert reads.read_sync("obj", 0, len(data)) == data
+            assert reads.perf.get("retries") >= 1
+        finally:
+            self.teardown_cluster(servers, backend)
