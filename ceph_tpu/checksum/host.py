@@ -1,0 +1,30 @@
+"""Host checksum dispatch — the ``ceph_crc32c`` runtime-probe analog.
+
+The reference probes CPU features once and routes every crc32c call to
+the fastest implementation (src/common/crc32c.cc:19-32). Here: native
+(SSE4.2 hardware or slicing-by-8, ceph_tpu.native) when the C++ tier
+loads, the bitwise Python oracle otherwise. Both are bit-identical —
+tests/test_native.py proves it on random vectors.
+
+The device-batched Checksummer kernels (checksum/crc32c.py) remain the
+bulk path; this is for host-side hot spots: wire frame CRCs, HashInfo
+chaining, deep-scrub verification.
+"""
+
+from __future__ import annotations
+
+from . import reference as _ref
+
+
+def _select():
+    try:
+        from ceph_tpu import native
+
+        if native.available():
+            return native.crc32c
+    except Exception:
+        pass
+    return _ref.crc32c_ref
+
+
+crc32c = _select()

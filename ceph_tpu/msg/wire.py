@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import struct
 
-from ceph_tpu.checksum.reference import crc32c_ref
+from ceph_tpu.checksum.host import crc32c as _crc32c_host
 
 MAGIC = b"CTv2"
 _HDR = struct.Struct("<4sHBBQ")  # magic, type, flags, nseg, seq
@@ -31,7 +31,7 @@ class BadFrame(Exception):
 
 
 def _crc(data: bytes) -> int:
-    return crc32c_ref(CRC_SEED, data)
+    return _crc32c_host(CRC_SEED, data)
 
 
 def encode_frame(msg_type: int, seq: int, segments: list[bytes]) -> bytes:

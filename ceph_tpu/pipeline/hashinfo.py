@@ -14,7 +14,7 @@ import json
 
 import numpy as np
 
-from ceph_tpu.checksum.reference import crc32c_ref
+from ceph_tpu.checksum.host import crc32c as crc32c_ref
 
 SEED = 0xFFFFFFFF
 

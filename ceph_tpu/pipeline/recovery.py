@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ceph_tpu.checksum.reference import crc32c_ref
+from ceph_tpu.checksum.host import crc32c as crc32c_ref
 from ceph_tpu.store import Transaction
 
 from .extents import ExtentSet
