@@ -1,0 +1,180 @@
+"""Golden-chunk corpus — the non-regression harness.
+
+Mirrors src/test/erasure-code/ceph_erasure_code_non_regression.cc +
+the ceph-erasure-code-corpus archive (SURVEY.md §2.1 "EC on-disk
+corpus"): encoded chunks for each plugin/profile are frozen on disk;
+``check`` re-encodes the archived payload and demands byte equality
+(encode must be deterministic forever — the cross-version
+bit-compatibility guarantee), then decodes every 1- and 2-erasure
+combination back to the archived content.
+
+Layout: ``<base>/<version>/<plugin>/<slug>/`` holding ``payload.bin``,
+``profile.json``, and ``chunk.<i>``.
+
+The payload generator is SHA-256 chaining — intentionally NOT a PRNG
+library whose stream could change across releases; the corpus must be
+reproducible from (seed, size) forever.
+
+CLI:
+    python -m ceph_tpu.corpus create --base tests/corpus/v0
+    python -m ceph_tpu.corpus check  --base tests/corpus/v0
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+from itertools import combinations
+
+# The default suite frozen at v0: one profile per plugin family plus
+# the headline configs from BASELINE.md.
+DEFAULT_SUITE: list[tuple[str, dict[str, str]]] = [
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "8", "m": "4"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2"}),
+    ("isa", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
+    ("isa", {"technique": "cauchy", "k": "4", "m": "2"}),
+    ("lrc", {"k": "4", "m": "2", "l": "3"}),
+    ("shec", {"k": "4", "m": "3", "c": "2"}),
+    ("clay", {"k": "4", "m": "2", "d": "5"}),
+]
+
+PAYLOAD_SIZE = 31 * 1024 + 17  # ragged on purpose: exercises padding
+
+
+def deterministic_payload(size: int, seed: str) -> bytes:
+    """SHA-256 counter-mode byte stream: stable across releases."""
+    out = bytearray()
+    counter = 0
+    while len(out) < size:
+        out += hashlib.sha256(f"{seed}:{counter}".encode()).digest()
+        counter += 1
+    return bytes(out[:size])
+
+
+def profile_slug(plugin: str, profile: dict[str, str]) -> str:
+    parts = [plugin] + [
+        f"{k}={profile[k]}" for k in sorted(profile)
+    ]
+    return "_".join(parts).replace("/", "-")
+
+
+def _codec(plugin: str, profile: dict[str, str]):
+    from ceph_tpu.codecs import registry
+
+    return registry.factory(plugin, dict(profile))
+
+
+def run_create(
+    base: str, plugin: str, profile: dict[str, str],
+    size: int = PAYLOAD_SIZE,
+) -> str:
+    """Archive payload + encoded chunks for one plugin/profile."""
+    slug = profile_slug(plugin, profile)
+    path = os.path.join(base, plugin, slug)
+    os.makedirs(path, exist_ok=True)
+    payload = deterministic_payload(size, seed=slug)
+    codec = _codec(plugin, profile)
+    chunks = codec.encode(payload)
+    with open(os.path.join(path, "payload.bin"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(path, "profile.json"), "w") as f:
+        json.dump({"plugin": plugin, "profile": profile, "size": size}, f,
+                  indent=1, sort_keys=True)
+    for i, chunk in sorted(chunks.items()):
+        with open(os.path.join(path, f"chunk.{i}"), "wb") as f:
+            f.write(chunk)
+    return path
+
+
+def run_check(path: str, max_erasures: int = 2) -> list[str]:
+    """Verify one archived corpus entry; returns a list of failures."""
+    errors: list[str] = []
+    with open(os.path.join(path, "profile.json")) as f:
+        meta = json.load(f)
+    plugin, profile = meta["plugin"], meta["profile"]
+    with open(os.path.join(path, "payload.bin"), "rb") as f:
+        payload = f.read()
+    if len(payload) != meta["size"]:
+        errors.append(f"payload size {len(payload)} != {meta['size']}")
+    codec = _codec(plugin, profile)
+    n = codec.get_chunk_count()
+    stored: dict[int, bytes] = {}
+    for i in range(n):
+        with open(os.path.join(path, f"chunk.{i}"), "rb") as f:
+            stored[i] = f.read()
+
+    # 1. Bit-compatibility: today's encode == the archived chunks.
+    now = codec.encode(payload)
+    for i in range(n):
+        if now[i] != stored[i]:
+            errors.append(f"chunk {i} re-encodes differently")
+
+    # 2. Every 1..max_erasures erasure combination decodes to the
+    #    archived chunks (the decode_erasures recursion of the
+    #    reference tool).
+    m = codec.get_coding_chunk_count()
+    for count in range(1, min(max_erasures, m) + 1):
+        for erased in combinations(range(n), count):
+            have = {i: c for i, c in stored.items() if i not in erased}
+            try:
+                out = codec.decode(set(erased), have)
+            except ValueError:
+                # Non-MDS families (SHEC trades decodability for
+                # recovery cost) legitimately reject some patterns.
+                if plugin in ("shec",):
+                    continue
+                errors.append(f"decode refused erasure {erased}")
+                continue
+            for e in erased:
+                if bytes(out[e]) != stored[e]:
+                    errors.append(f"erasure {erased}: chunk {e} differs")
+    return errors
+
+
+def iter_entries(base: str):
+    for plugin in sorted(os.listdir(base)):
+        pdir = os.path.join(base, plugin)
+        if not os.path.isdir(pdir):
+            continue
+        for slug in sorted(os.listdir(pdir)):
+            entry = os.path.join(pdir, slug)
+            if os.path.isfile(os.path.join(entry, "profile.json")):
+                yield entry
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="ceph_tpu.corpus")
+    p.add_argument("action", choices=["create", "check"])
+    p.add_argument("--base", default="tests/corpus/v0")
+    p.add_argument("--size", type=int, default=PAYLOAD_SIZE)
+    args = p.parse_args(argv)
+
+    from ceph_tpu.utils import honor_platform_env
+
+    honor_platform_env()
+
+    if args.action == "create":
+        for plugin, profile in DEFAULT_SUITE:
+            path = run_create(args.base, plugin, profile, args.size)
+            print(f"created {path}")
+        return 0
+
+    failed = 0
+    for entry in iter_entries(args.base):
+        errors = run_check(entry)
+        status = "ok" if not errors else "FAIL"
+        print(f"{status}  {entry}")
+        for e in errors:
+            print(f"      {e}")
+        failed += bool(errors)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
