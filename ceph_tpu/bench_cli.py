@@ -56,8 +56,9 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
-def main(argv: list[str] | None = None) -> int:
-    args = parse_args(argv)
+def run(args: argparse.Namespace) -> tuple[float, float]:
+    """Execute one workload; returns (elapsed_seconds, total_KiB).
+    Raises RuntimeError if a decoded chunk differs from the original."""
     from ceph_tpu.utils import honor_platform_env
 
     honor_platform_env()
@@ -127,8 +128,17 @@ def main(argv: list[str] | None = None) -> int:
             total_kib += args.batch * k * chunk / 1024
             for e in erased:
                 if not (np.asarray(out[e]) == originals[e]).all():
-                    print(f"chunk {e} differs after decode", file=sys.stderr)
-                    return 1
+                    raise RuntimeError(f"chunk {e} differs after decode")
+    return elapsed, total_kib
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = parse_args(argv)
+    try:
+        elapsed, total_kib = run(args)
+    except RuntimeError as e:
+        print(e, file=sys.stderr)
+        return 1
     # The reference's two-column contract: elapsed seconds TAB total KiB.
     print(f"{elapsed:.6f}\t{int(total_kib)}")
     return 0

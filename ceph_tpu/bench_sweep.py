@@ -1,0 +1,128 @@
+"""k/m sweep benchmark harness — the bench.sh + bench.html analog.
+
+Mirrors qa/workunits/erasure-code/bench.sh:20-48: sweep k (and m)
+across plugins, run the encode and/or decode workload for each
+configuration through the ``ecbench`` CLI machinery, and emit results
+as JSON lines plus an optional self-contained HTML bar chart (the
+flot-plot role, dependency-free).
+
+    python -m ceph_tpu.bench_sweep --plugins isa jerasure \
+        --k 2 4 8 --m 2 4 --size 16777216 --iterations 5 \
+        --html bench.html
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="ceph_tpu.bench_sweep")
+    p.add_argument("--plugins", nargs="+", default=["isa", "jerasure"])
+    p.add_argument("--k", nargs="+", type=int, default=[2, 4, 6, 8, 11])
+    p.add_argument("--m", nargs="+", type=int, default=[2])
+    p.add_argument("--workloads", nargs="+", default=["encode", "decode"],
+                   choices=["encode", "decode"])
+    p.add_argument("--size", type=int, default=16 * 1024 * 1024)
+    p.add_argument("--iterations", type=int, default=10)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--erasures", type=int, default=1)
+    p.add_argument("--html", default=None,
+                   help="also write a self-contained HTML chart here")
+    return p.parse_args(argv)
+
+
+def sweep(args) -> list[dict]:
+    from ceph_tpu import bench_cli
+
+    results = []
+    for plugin in args.plugins:
+        for k in args.k:
+            for m in args.m:
+                for workload in args.workloads:
+                    argv = [
+                        workload, "--plugin", plugin,
+                        "-P", f"k={k}", "-P", f"m={m}",
+                        "--size", str(args.size),
+                        "--iterations", str(args.iterations),
+                        "--batch", str(args.batch),
+                        "--erasures", str(args.erasures),
+                    ]
+                    if plugin == "jerasure":
+                        argv += ["-P", "technique=reed_sol_van"]
+                    try:
+                        elapsed, total_kib = bench_cli.run(
+                            bench_cli.parse_args(argv)
+                        )
+                    except (ValueError, RuntimeError) as e:
+                        results.append({
+                            "plugin": plugin, "k": k, "m": m,
+                            "workload": workload, "error": str(e),
+                        })
+                        continue
+                    gbps = total_kib * 1024 / max(elapsed, 1e-9) / 1e9
+                    row = {
+                        "plugin": plugin, "k": k, "m": m,
+                        "workload": workload,
+                        "seconds": round(elapsed, 6),
+                        "KiB": int(total_kib),
+                        "GBps": round(gbps, 3),
+                    }
+                    results.append(row)
+                    print(json.dumps(row), flush=True)
+    return results
+
+
+_HTML = """<!doctype html><meta charset="utf-8">
+<title>ceph_tpu EC bench sweep</title>
+<style>
+ body {{ font: 14px system-ui; margin: 2em; }}
+ .bar {{ height: 18px; background: #4a79a4; margin: 2px 0; }}
+ .row {{ display: grid; grid-template-columns: 22em 1fr 7em;
+         gap: .75em; align-items: center; }}
+ .lbl {{ text-align: right; color: #333; }}
+ .val {{ color: #555; }}
+</style>
+<h1>EC throughput sweep</h1>
+<div id="chart"></div>
+<script>
+const data = {data};
+const max = Math.max(...data.filter(d => d.GBps).map(d => d.GBps));
+const el = document.getElementById("chart");
+for (const d of data) {{
+  const row = document.createElement("div");
+  row.className = "row";
+  const label = `${{d.plugin}} k=${{d.k}} m=${{d.m}} ${{d.workload}}`;
+  if (d.error) {{
+    row.innerHTML = `<div class="lbl">${{label}}</div>` +
+      `<div></div><div class="val">error</div>`;
+  }} else {{
+    const w = (100 * d.GBps / max).toFixed(1);
+    row.innerHTML = `<div class="lbl">${{label}}</div>` +
+      `<div><div class="bar" style="width:${{w}}%"></div></div>` +
+      `<div class="val">${{d.GBps}} GB/s</div>`;
+  }}
+  el.appendChild(row);
+}}
+</script>
+"""
+
+
+def write_html(path: str, results: list[dict]) -> None:
+    with open(path, "w") as f:
+        f.write(_HTML.format(data=json.dumps(results)))
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    results = sweep(args)
+    if args.html:
+        write_html(args.html, results)
+        print(f"wrote {args.html}", file=sys.stderr)
+    return 0 if all("error" not in r for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""Plugin load-path contracts — the ErasureCodePlugin* fake-plugin
+suite (src/test/erasure-code/ErasureCodePluginFailToInitialize/
+FailToRegister/MissingEntryPoint/MissingVersion.cc analogs): the
+load path's failure behaviors are a tested contract, not incidental.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from ceph_tpu import PLUGIN_ABI_VERSION
+from ceph_tpu.codecs.registry import (
+    ErasureCodePluginRegistry,
+    PluginLoadError,
+    registry,
+)
+
+
+class TestLoadFailures:
+    def test_unknown_plugin(self):
+        with pytest.raises(PluginLoadError, match="cannot load"):
+            registry.load("no_such_plugin")
+
+    def test_module_without_registration(self, monkeypatch):
+        """A plugin module that imports fine but never registers —
+        the MissingEntryPoint analog."""
+        mod = types.ModuleType("ceph_tpu.codecs.fake_noreg")
+        monkeypatch.setitem(sys.modules, "ceph_tpu.codecs.fake_noreg", mod)
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(PluginLoadError, match="did not register"):
+            r.load("fake_noreg")
+
+    def test_version_mismatch(self):
+        """The __erasure_code_version handshake (MissingVersion /
+        wrong-version analog)."""
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(PluginLoadError, match="ABI"):
+            r.register("fake_old", lambda: None, version="v0-ancient")
+
+    def test_duplicate_registration(self):
+        r = ErasureCodePluginRegistry()
+        r.register("dup", lambda: None)
+        with pytest.raises(PluginLoadError, match="already registered"):
+            r.register("dup", lambda: None)
+
+    def test_fail_to_initialize(self, monkeypatch):
+        """Factory whose init raises — FailToInitialize analog: the
+        error propagates to the caller (mon-side profile validation)."""
+        mod = types.ModuleType("ceph_tpu.codecs.fake_badinit")
+
+        class BadInit:
+            def init(self, profile):
+                raise ValueError("broken plugin")
+
+        r = ErasureCodePluginRegistry()
+
+        def fake_import(name):
+            r.register("fake_badinit", BadInit)
+            return mod
+
+        monkeypatch.setitem(
+            sys.modules, "ceph_tpu.codecs.fake_badinit", mod
+        )
+        r.register("fake_badinit", BadInit)
+        with pytest.raises(ValueError, match="broken plugin"):
+            r.factory("fake_badinit", {})
+
+
+class TestPreloadAndCaching:
+    def test_preload_all_families(self):
+        registry.preload(["jerasure", "isa", "lrc", "shec", "clay"])
+        for name in ("jerasure", "isa", "lrc", "shec", "clay"):
+            assert name in registry.names()
+
+    def test_load_idempotent(self):
+        registry.load("isa")
+        registry.load("isa")  # cached, no duplicate-registration error
+
+    def test_create_codec_convenience(self):
+        from ceph_tpu.codecs.registry import create_codec
+
+        c = create_codec("isa", k=4, m=2)
+        assert c.get_data_chunk_count() == 4
+
+
+class TestExampleCodec:
+    """Base-class behavior against the toy XOR code."""
+
+    def make(self, k=3):
+        return registry.factory("example", {"k": str(k)})
+
+    def test_round_trip_any_single_erasure(self, rng):
+        import jax.numpy as jnp
+
+        codec = self.make(4)
+        data = rng.integers(0, 256, (4, 256), np.uint8)
+        parity = codec.encode_chunks(
+            {i: jnp.asarray(data[i]) for i in range(4)}
+        )
+        chunks = {i: jnp.asarray(data[i]) for i in range(4)}
+        chunks[4] = parity[4]
+        for lost in range(5):
+            have = {i: c for i, c in chunks.items() if i != lost}
+            out = codec.decode_chunks({lost}, have)
+            expect = (
+                data[lost]
+                if lost < 4
+                else np.asarray(parity[4])
+            )
+            assert (np.asarray(out[lost]) == expect).all(), lost
+
+    def test_double_erasure_rejected(self, rng):
+        import jax.numpy as jnp
+
+        codec = self.make(3)
+        data = rng.integers(0, 256, (3, 64), np.uint8)
+        parity = codec.encode_chunks(
+            {i: jnp.asarray(data[i]) for i in range(3)}
+        )
+        with pytest.raises(ValueError):
+            codec.decode_chunks(
+                {0, 1}, {2: jnp.asarray(data[2]), 3: parity[3]}
+            )
+
+    def test_byte_level_encode_decode(self, rng):
+        codec = self.make(3)
+        payload = rng.integers(0, 256, 1000, np.uint8).tobytes()
+        chunks = codec.encode(payload)
+        assert len(chunks) == 4
+        out = codec.decode({1}, {i: c for i, c in chunks.items() if i != 1})
+        assert out[1] == chunks[1]
+
+    def test_profile_validation(self):
+        with pytest.raises(ValueError):
+            self.make(k=1)
