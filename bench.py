@@ -4,12 +4,25 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline target: 25 GB/s/chip on TPU v5e-1 (BASELINE.json north star).
 ``vs_baseline`` is the ratio value / 25.
 
-Methodology mirrors the reference tool's shape
-(src/test/erasure-code/ceph_erasure_code_benchmark.cc: big buffer,
-fixed iteration count, throughput = bytes/elapsed) with one TPU-ism:
-iterations are enqueued without per-call sync (per-dispatch sync
-latency through the device tunnel would measure the network, not the
-chip) and the clock stops on the final block_until_ready.
+Methodology — honest under the axon device tunnel, where
+``block_until_ready`` resolves without waiting for remote execution
+and any real sync costs a ~0.1-0.5 s round trip:
+
+1. The iteration loop runs ON DEVICE (``lax.fori_loop``); each
+   iteration perturbs the input (so the encode is not loop-invariant)
+   and XOR-folds the parity into an accumulator the final readback
+   depends on — execution cannot be elided or overlapped away.
+2. Work is forced by reading back one byte of the accumulator
+   (``np.asarray``), not by ``block_until_ready``.
+3. The fixed tunnel round trip is cancelled by differencing two trip
+   counts: per_iter = (t(N2) - t(N1)) / (N2 - N1).
+4. A perturb-only loop measured the same way is subtracted so the
+   reported number is the encode alone.
+
+The reference tool's spirit is kept (big buffer, fixed iteration
+count, throughput = bytes/elapsed —
+src/test/erasure-code/ceph_erasure_code_benchmark.cc) with the timing
+adapted to remote-device reality.
 """
 
 from __future__ import annotations
@@ -22,7 +35,7 @@ import numpy as np
 K, M = 8, 4
 CHUNK = 1 << 20          # 1 MiB per shard
 BATCH = 8                # stripes per dispatch -> 64 MiB input per iter
-ITERS = 30
+N1, N2 = 10, 110  # large span: the diff must dwarf tunnel RTT jitter
 TARGET_GBPS = 25.0
 
 
@@ -32,25 +45,79 @@ def main() -> None:
 
     from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
     from ceph_tpu.ops.bitplane import gf_encode_bitplane
+    from ceph_tpu.ops import pallas_encode as pe
 
     g = vandermonde_rs_matrix(K, M)
-    bmat = jnp.asarray(gf_matrix_to_bitmatrix(g[K:, :]))
+    bmat_np = gf_matrix_to_bitmatrix(g[K:, :])
+    bmat = jnp.asarray(bmat_np)
     rng = np.random.default_rng(0)
     data = jnp.asarray(
         rng.integers(0, 256, (BATCH, K, CHUNK)).astype(np.uint8)
     )
-    enc = jax.jit(gf_encode_bitplane)
-    enc(bmat, data).block_until_ready()  # compile + warm
 
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(ITERS):
-        out = enc(bmat, data)
-    out.block_until_ready()
-    elapsed = time.perf_counter() - t0
+    # The codec's TPU path: fused Pallas MXU kernel (einsum off-TPU).
+    use_pallas = pe.on_tpu() and pe.supported(data.shape)
+    if use_pallas:
+        big = jnp.asarray(pe._folded_bitmatrix(bmat_np, pe.FOLD))
 
-    total_bytes = ITERS * BATCH * K * CHUNK
-    gbps = total_bytes / elapsed / 1e9
+        def encode(bm, d):
+            return pe._encode_tiled(big, d, pe.FOLD, interpret=False)
+    else:
+
+        def encode(bm, d):
+            return gf_encode_bitplane(bm, d)
+
+    @jax.jit
+    def loop_enc(bmat, data, iters):
+        def body(i, carry):
+            d, acc = carry
+            d = jnp.bitwise_xor(d, jnp.uint8(i + 1))
+            p = encode(bmat, d)
+            return d, jnp.bitwise_xor(acc, p)
+
+        _, acc = jax.lax.fori_loop(
+            0, iters, body,
+            (data, jnp.zeros((BATCH, M, CHUNK), jnp.uint8)),
+        )
+        return acc[0, 0, 0]
+
+    @jax.jit
+    def loop_perturb(data, iters):
+        def body(i, carry):
+            d, acc = carry
+            d = jnp.bitwise_xor(d, jnp.uint8(i + 1))
+            return d, jnp.bitwise_xor(acc, d[:, :M, :])
+
+        _, acc = jax.lax.fori_loop(
+            0, iters, body,
+            (data, jnp.zeros((BATCH, M, CHUNK), jnp.uint8)),
+        )
+        return acc[0, 0, 0]
+
+    def timed(fn, *args) -> float:
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))  # readback forces real remote execution
+        return time.perf_counter() - t0
+
+    # compile + warm both trip counts
+    for n in (N1, N2):
+        timed(loop_enc, bmat, data, n)
+        timed(loop_perturb, data, n)
+
+    # Repeat and keep the minimum: tunnel latency jitter is additive,
+    # so the noise floor is the honest estimate.
+    def per_iter(fn, *args) -> float:
+        best = float("inf")
+        for _ in range(3):
+            d = (timed(fn, *args, N2) - timed(fn, *args, N1)) / (N2 - N1)
+            best = min(best, d)
+        return best
+
+    per_iter_full = per_iter(loop_enc, bmat, data)
+    per_iter_perturb = per_iter(loop_perturb, data)
+    enc_s = max(per_iter_full - per_iter_perturb, 1e-9)
+
+    gbps = BATCH * K * CHUNK / enc_s / 1e9
     print(
         json.dumps(
             {

@@ -75,9 +75,10 @@ class MatrixErasureCodec(ErasureCodeBase):
     def _set_generator(self, generator: np.ndarray) -> None:
         self.generator = np.asarray(generator, dtype=np.uint8)
         assert self.generator.shape == (self.k + self.m, self.k)
-        self._encode_bmat = jnp.asarray(
-            gf_matrix_to_bitmatrix(self.generator[self.k :, :])
+        self._encode_bmat_np = gf_matrix_to_bitmatrix(
+            self.generator[self.k :, :]
         )
+        self._encode_bmat = jnp.asarray(self._encode_bmat_np)
 
     def get_flags(self) -> Flag:
         return (
@@ -94,10 +95,30 @@ class MatrixErasureCodec(ErasureCodeBase):
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
         stacked = self._stack_data(data)
-        parity = _apply_bitmatrix(self._encode_bmat, stacked)
+        parity = self._encode_stacked(stacked)
         return {
             self.k + i: parity[..., i, :] for i in range(self.m)
         }
+
+    def _encode_stacked(self, stacked: jax.Array) -> jax.Array:
+        """Dispatch the parity matmul: the fused Pallas MXU kernel on
+        TPU when the shape tiles (config-gated), einsum otherwise."""
+        from ceph_tpu.ops import pallas_encode as pe
+        from ceph_tpu.utils import config
+
+        lead = stacked.shape[:-2]
+        flat_shape = (-1,) + stacked.shape[-2:]
+        if (
+            config.get("ec_use_pallas")
+            and pe.on_tpu()
+            and pe.supported((1,) + stacked.shape[-2:])
+        ):
+            flat = stacked.reshape(flat_shape)
+            parity = pe.gf_encode_bitplane_pallas(
+                self._encode_bmat_np, flat
+            )
+            return parity.reshape(lead + parity.shape[-2:])
+        return _apply_bitmatrix(self._encode_bmat, stacked)
 
     # -- decode -------------------------------------------------------
     def decode_chunks(
