@@ -1,0 +1,79 @@
+"""Compression plugin family: round trips, registry handshake, mode
+hints, and the required-ratio gate (Compressor.h contracts)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs.registry import PluginLoadError
+from ceph_tpu.compressor import (
+    CompressionMode,
+    maybe_compress,
+    registry,
+)
+from ceph_tpu.compressor.compressor import Hint, should_compress
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("name", ["zlib", "bz2", "lzma", "none"])
+    def test_round_trip(self, name, rng):
+        comp = registry.create(name)
+        data = rng.integers(0, 16, 50_000, np.uint8).tobytes()  # squashy
+        out, msg = comp.compress(data)
+        assert comp.decompress(out, msg) == data
+        if name != "none":
+            assert len(out) < len(data)
+
+    @pytest.mark.parametrize("name", ["zlib", "bz2", "lzma"])
+    def test_corrupt_input_raises(self, name):
+        comp = registry.create(name)
+        with pytest.raises(ValueError):
+            comp.decompress(b"\x00garbage\xff" * 10)
+
+
+class TestRegistry:
+    def test_names(self):
+        assert {"none", "zlib", "bz2", "lzma"} <= set(registry.names())
+
+    def test_unknown(self):
+        with pytest.raises(PluginLoadError):
+            registry.create("snappy9000")
+
+    def test_version_handshake(self):
+        with pytest.raises(PluginLoadError, match="ABI"):
+            registry.register("old", lambda: None, version="v0")
+
+
+class TestModes:
+    def test_matrix(self):
+        cases = [
+            (CompressionMode.NONE, Hint.COMPRESSIBLE, False),
+            (CompressionMode.FORCE, Hint.INCOMPRESSIBLE, True),
+            (CompressionMode.PASSIVE, Hint.NONE, False),
+            (CompressionMode.PASSIVE, Hint.COMPRESSIBLE, True),
+            (CompressionMode.AGGRESSIVE, Hint.NONE, True),
+            (CompressionMode.AGGRESSIVE, Hint.INCOMPRESSIBLE, False),
+        ]
+        for mode, hint, want in cases:
+            assert should_compress(mode, hint) is want, (mode, hint)
+
+
+class TestRequiredRatio:
+    def test_keeps_compressed_when_worth_it(self):
+        comp = registry.create("zlib")
+        data = b"A" * 10_000
+        blob, compressed, msg = maybe_compress(comp, data)
+        assert compressed and len(blob) < len(data)
+        assert comp.decompress(blob, msg) == data
+
+    def test_rejects_incompressible(self, rng):
+        comp = registry.create("zlib")
+        data = rng.integers(0, 256, 10_000, np.uint8).tobytes()
+        blob, compressed, _ = maybe_compress(comp, data)
+        assert not compressed and blob == data
+
+    def test_mode_none_passthrough(self):
+        comp = registry.create("zlib")
+        blob, compressed, _ = maybe_compress(
+            comp, b"A" * 1000, mode=CompressionMode.NONE
+        )
+        assert not compressed and blob == b"A" * 1000
