@@ -110,12 +110,38 @@ def _pick_chunk(block_bytes: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
+def _host_fold(block_bytes: int, c: int):
+    return (
+        fold_tensor(block_bytes, c),
+        _mat(zero_gap_matrix(block_bytes)),
+    )
+
+
+_device_cache: dict = {}
+
+
 def _device_fold(block_bytes: int, c: int):
     """Device-resident (K, A_total) — uploaded once per block size, not
-    per call (re-upload measured 10x+ slower through the device tunnel)."""
-    k_fold = jnp.asarray(fold_tensor(block_bytes, c), dtype=jnp.int8)
-    a_total = jnp.asarray(_mat(zero_gap_matrix(block_bytes)), dtype=jnp.int8)
-    return k_fold, a_total
+    per call (re-upload measured 10x+ slower through the device
+    tunnel). Under an active trace (crc32c_device inside a jit or
+    shard_map) the arrays become tracers, which must NOT be cached —
+    they are embedded as compile-time constants instead."""
+    kf, at = _host_fold(block_bytes, c)
+    try:
+        import jax.core
+
+        tracing = not jax.core.trace_state_clean()
+    except Exception:
+        tracing = True  # be safe: never cache inside unknown state
+    if tracing:
+        return jnp.asarray(kf, jnp.int8), jnp.asarray(at, jnp.int8)
+    key = (block_bytes, c)
+    if key not in _device_cache:
+        _device_cache[key] = (
+            jnp.asarray(kf, jnp.int8),
+            jnp.asarray(at, jnp.int8),
+        )
+    return _device_cache[key]
 
 
 @functools.partial(jax.jit, static_argnames=("block_bytes",))

@@ -2,6 +2,7 @@
 
 from .mesh import (  # noqa: F401
     make_ec_mesh,
+    sharded_decode,
     sharded_encode,
     sharded_pipeline_step,
 )

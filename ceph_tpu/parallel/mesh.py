@@ -83,23 +83,32 @@ def sharded_encode(
     return fn(bitmatrix, data)
 
 
+def sharded_decode(
+    mesh: Mesh, dec_bitmatrix: jax.Array, survivors: jax.Array
+) -> jax.Array:
+    """Distributed reconstruct: decode is the same mod-2 matmul as
+    encode with the inverted-submatrix rows, so the survivor axis
+    shards over ``sp`` and the partial products combine with the same
+    XOR-allreduce. ``survivors`` is [B, k, N] (any k survivors, rows
+    matching the decode matrix columns); returns the missing shards.
+    """
+    return sharded_encode(mesh, dec_bitmatrix, survivors)
+
+
 def sharded_pipeline_step(
     mesh: Mesh, bitmatrix: jax.Array, data: jax.Array
 ) -> dict[str, jax.Array]:
     """One full distributed EC step — the framework's "training step":
 
-    encode (sp-XOR-allreduce across the shard axis) followed by a
-    per-chunk checksum fold. Jit-able under the mesh; the driver
-    dry-runs this over N virtual devices and separately verifies a
-    degraded-read reconstruct (see __graft_entry__.dryrun_multichip).
+    encode (sp-XOR-allreduce across the shard axis) followed by the
+    real per-chunk Checksummer CRC32C fold (the HashInfo/deep-scrub
+    integrity word, computed on device). Jit-able under the mesh; the
+    driver dry-runs this over N virtual devices and separately
+    verifies a degraded-read reconstruct
+    (see __graft_entry__.dryrun_multichip).
     """
+    from ceph_tpu.checksum.crc32c import crc32c_device
+
     parity = sharded_encode(mesh, bitmatrix, data)
-    # Lightweight per-chunk integrity word (placeholder until the
-    # Checksummer family lands): XOR-fold each parity chunk to 1 byte.
-    csum = jax.lax.reduce(
-        parity.astype(jnp.uint32),
-        jnp.uint32(0),
-        jax.lax.bitwise_xor,
-        dimensions=(2,),
-    )
+    csum = crc32c_device(parity)  # [B, m] uint32, one per parity chunk
     return {"parity": parity, "csum": csum}
