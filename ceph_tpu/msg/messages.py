@@ -26,6 +26,8 @@ MSG_EC_SUB_WRITE = 108        # MOSDECSubOpWrite
 MSG_EC_SUB_WRITE_REPLY = 109  # MOSDECSubOpWriteReply
 MSG_EC_SUB_READ = 110         # MOSDECSubOpRead
 MSG_EC_SUB_READ_REPLY = 111   # MOSDECSubOpReadReply
+MSG_PING = 112                # MOSDPing analog (heartbeats)
+MSG_PONG = 113
 
 VERSION = 1
 
@@ -164,11 +166,43 @@ class ECSubReadReply:
         return cls(h["tid"], h["shard"], h["offsets"], buffers, h["error"])
 
 
+@dataclass
+class Ping:
+    """Heartbeat probe (the OSD::handle_osd_ping analog)."""
+
+    tid: int
+    shard: int
+
+    def encode(self) -> list[bytes]:
+        return [_header("ping", {"tid": self.tid, "shard": self.shard})]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "Ping":
+        h = _parse(segments[0], "ping")
+        return cls(h["tid"], h["shard"])
+
+
+@dataclass
+class Pong:
+    tid: int
+    shard: int
+
+    def encode(self) -> list[bytes]:
+        return [_header("pong", {"tid": self.tid, "shard": self.shard})]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "Pong":
+        h = _parse(segments[0], "pong")
+        return cls(h["tid"], h["shard"])
+
+
 _DECODERS = {
     MSG_EC_SUB_WRITE: ECSubWrite.decode,
     MSG_EC_SUB_WRITE_REPLY: ECSubWriteReply.decode,
     MSG_EC_SUB_READ: ECSubRead.decode,
     MSG_EC_SUB_READ_REPLY: ECSubReadReply.decode,
+    MSG_PING: Ping.decode,
+    MSG_PONG: Pong.decode,
 }
 
 _TYPE_OF = {
@@ -176,6 +210,8 @@ _TYPE_OF = {
     ECSubWriteReply: MSG_EC_SUB_WRITE_REPLY,
     ECSubRead: MSG_EC_SUB_READ,
     ECSubReadReply: MSG_EC_SUB_READ_REPLY,
+    Ping: MSG_PING,
+    Pong: MSG_PONG,
 }
 
 

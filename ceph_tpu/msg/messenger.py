@@ -34,7 +34,12 @@ class Connection:
         self._reader.start()
 
     def send(self, msg) -> None:
-        frame = encode_frame(message_type(msg), self._next_seq(), msg.encode())
+        frame = encode_frame(
+            message_type(msg),
+            self._next_seq(),
+            msg.encode(),
+            compress=self.messenger.compress,
+        )
         with self._send_lock:
             try:
                 self.sock.sendall(frame)
@@ -90,8 +95,11 @@ class Connection:
 class Messenger:
     """Bind/connect endpoint + dispatcher registry."""
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, compress: bool = False) -> None:
         self.name = name
+        # On-wire compression for frames WE send (receivers auto-detect
+        # via the frame flags — compression_onwire.cc role).
+        self.compress = compress
         self.dispatcher: Callable[[Connection, object], None] | None = None
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None

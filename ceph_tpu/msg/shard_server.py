@@ -37,6 +37,8 @@ from .messages import (
     ECSubReadReply,
     ECSubWrite,
     ECSubWriteReply,
+    Ping,
+    Pong,
 )
 from .messenger import Connection, Messenger
 
@@ -65,7 +67,9 @@ class ShardServer:
 
     # -- sub-op handlers (handle_sub_write / handle_sub_read) ----------
     def _dispatch(self, conn: Connection, msg) -> None:
-        if isinstance(msg, ECSubWrite):
+        if isinstance(msg, Ping):
+            conn.send(Pong(msg.tid, self.shard))
+        elif isinstance(msg, ECSubWrite):
             self._local.submit_shard_txn(
                 self.shard,
                 msg.txn,
@@ -131,6 +135,9 @@ class NetShardBackend:
         self._lock = threading.Lock()
         self._waiting: dict[tuple[int, int], _Pending] = {}
         self._inbox: "queue.Queue[Callable[[], None]]" = queue.Queue()
+        self._last_seen: dict[int, float] = {}
+        self._hb_stop: threading.Event | None = None
+        self._hb_thread: threading.Thread | None = None
 
     # -- plumbing ------------------------------------------------------
     def _conn(self, shard: int) -> Connection:
@@ -144,7 +151,11 @@ class NetShardBackend:
         return conn
 
     def _dispatch(self, conn: Connection, msg) -> None:
-        """Reader thread: queue the reply for the caller to drain."""
+        """Reader thread: queue the reply for the caller to drain.
+        Pongs update liveness directly (no pipeline state touched)."""
+        if isinstance(msg, Pong):
+            self._last_seen[msg.shard] = time.monotonic()
+            return
         if not isinstance(msg, (ECSubWriteReply, ECSubReadReply)):
             return  # a reflected request must never satisfy an RPC
         with self._lock:
@@ -216,6 +227,7 @@ class NetShardBackend:
             conn = self._conns.pop(shard, None)
         if conn is not None:
             conn.close()
+        self._last_seen[shard] = time.monotonic()
         self.down_shards.discard(shard)
 
     def avail_shards(self) -> set[int]:
@@ -270,5 +282,49 @@ class NetShardBackend:
         self._register(tid, shard, "", on_reply, is_read=False)
         self._send(shard, ECSubWrite(tid, shard, txn), tid)
 
+    # -- heartbeats (OSD::handle_osd_ping / stale-ping culling) --------
+    def start_heartbeat(
+        self, period: float = 0.5, grace: float = 2.0
+    ) -> None:
+        """Ping every shard each ``period`` seconds; a shard silent for
+        ``grace`` seconds (or unreachable) is marked down so the
+        planners route around it BEFORE any IO trips over the failure
+        (osd/OSD.cc:5854 heartbeat + :6148 stale-ping culling).
+        Down-marking is one-way: a replaced daemon comes back via
+        ``set_addr`` (the osdmap-update path), never silently."""
+        self.stop_heartbeat()
+        self._hb_stop = threading.Event()
+        now = time.monotonic()
+        for shard in self.addrs:
+            self._last_seen.setdefault(shard, now)
+
+        def loop() -> None:
+            while not self._hb_stop.wait(period):
+                for shard in list(self.addrs):
+                    if shard in self.down_shards:
+                        continue
+                    try:
+                        self._conn(shard).send(
+                            Ping(next(self._tids), shard)
+                        )
+                    except (ConnectionError, OSError):
+                        self.down_shards.add(shard)
+                        continue
+                    age = time.monotonic() - self._last_seen.get(shard, 0)
+                    if age > grace:
+                        self.down_shards.add(shard)
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=2.0)
+        self._hb_stop = None
+        self._hb_thread = None
+
     def shutdown(self) -> None:
+        self.stop_heartbeat()
         self.messenger.shutdown()

@@ -7,13 +7,16 @@ segment's crc32c is verified on decode — a flipped bit anywhere raises
 ``BadFrame``, the on-wire integrity contract ProtocolV2 provides
 (SURVEY.md section 5.8; the reference seeds crc32c with -1).
 
-AES-GCM secure mode and on-wire compression are out of scope for now;
-the header reserves a flags byte for both.
+On-wire compression is flag bit 0 (the compression_onwire.cc analog):
+segments are zlib-deflated before framing and the per-segment CRC
+covers the compressed bytes, so corruption is still caught before any
+decompressor touches the data. AES-GCM secure mode remains reserved.
 """
 
 from __future__ import annotations
 
 import struct
+import zlib
 
 from ceph_tpu.checksum.host import crc32c as _crc32c_host
 
@@ -21,6 +24,8 @@ MAGIC = b"CTv2"
 _HDR = struct.Struct("<4sHBBQ")  # magic, type, flags, nseg, seq
 _SEG = struct.Struct("<II")      # length, crc32c
 CRC_SEED = 0xFFFFFFFF
+
+FLAG_COMPRESSED = 0x01
 
 MAX_SEGMENTS = 8
 MAX_SEGMENT_BYTES = 1 << 30
@@ -34,10 +39,16 @@ def _crc(data: bytes) -> int:
     return _crc32c_host(CRC_SEED, data)
 
 
-def encode_frame(msg_type: int, seq: int, segments: list[bytes]) -> bytes:
+def encode_frame(
+    msg_type: int, seq: int, segments: list[bytes], compress: bool = False
+) -> bytes:
     if not 0 < len(segments) <= MAX_SEGMENTS:
         raise ValueError(f"1..{MAX_SEGMENTS} segments, got {len(segments)}")
-    out = bytearray(_HDR.pack(MAGIC, msg_type, 0, len(segments), seq))
+    flags = 0
+    if compress:
+        flags |= FLAG_COMPRESSED
+        segments = [zlib.compress(seg, 1) for seg in segments]
+    out = bytearray(_HDR.pack(MAGIC, msg_type, flags, len(segments), seq))
     for seg in segments:
         out += _SEG.pack(len(seg), _crc(seg))
     for seg in segments:
@@ -47,12 +58,13 @@ def encode_frame(msg_type: int, seq: int, segments: list[bytes]) -> bytes:
 
 def decode_frame(read_exact) -> tuple[int, int, list[bytes]]:
     """Parse one frame from ``read_exact(n) -> bytes`` (raises
-    ``EOFError`` at stream end). Returns (msg_type, seq, segments)."""
+    ``EOFError`` at stream end). Returns (msg_type, seq, segments).
+    Compressed frames are transparently inflated AFTER CRC checks."""
     hdr = read_exact(_HDR.size)
     magic, msg_type, flags, nseg, seq = _HDR.unpack(hdr)
     if magic != MAGIC:
         raise BadFrame(f"bad magic {magic!r}")
-    if flags != 0:
+    if flags & ~FLAG_COMPRESSED:
         raise BadFrame(f"unsupported flags {flags:#x}")
     if not 0 < nseg <= MAX_SEGMENTS:
         raise BadFrame(f"bad segment count {nseg}")
@@ -69,6 +81,11 @@ def decode_frame(read_exact) -> tuple[int, int, list[bytes]]:
             raise BadFrame(
                 f"segment crc mismatch: got {_crc(seg):#x} want {crc:#x}"
             )
+        if flags & FLAG_COMPRESSED:
+            try:
+                seg = zlib.decompress(seg)
+            except zlib.error as e:
+                raise BadFrame(f"segment inflate failed: {e}") from e
         segments.append(seg)
     return msg_type, seq, segments
 
