@@ -13,6 +13,7 @@ from .stripe import StripeInfo
 from .shard_map import ShardExtentMap
 from .read import ReadPipeline, ShardReadError
 from .recovery import RecoveryBackend, RecoveryState, be_deep_scrub
+from .pglog import PGLog
 
 __all__ = [
     "ExtentSet",
@@ -24,4 +25,5 @@ __all__ = [
     "RecoveryBackend",
     "RecoveryState",
     "be_deep_scrub",
+    "PGLog",
 ]

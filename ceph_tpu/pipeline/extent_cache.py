@@ -182,7 +182,11 @@ class ECExtentCache:
         for oid, q in list(self._ops.items()):
             for op in list(q):
                 if op.invoked:
-                    continue
+                    # Invoked but still in the queue = its write hasn't
+                    # landed (write_done removes completed ops). A later
+                    # op must NOT proceed against pre-write cache state
+                    # — that encodes stale data into parity. Serialize.
+                    break
                 if self._missing(op):
                     break  # never reorder: stop at first unready op
                 op.result = self._snapshot(op)

@@ -1,0 +1,121 @@
+"""Write-ahead op log — the ``PGLog`` analog (osd/PGLog.{h,cc}).
+
+The reference's per-PG log is the replayable journal that makes
+recovery DELTA-shaped: a shard that missed some sub-writes (dropped
+ack, brief outage) catches up by re-fetching only the extents written
+since its last completed version, instead of a full backfill
+(SURVEY.md §5.4; divergent-entry rollback/rollforward is the
+``completed_to``/``pending_roll_forward`` machinery of ECCommon.h:500).
+
+Here: the RMW pipeline appends one entry per client write (tid-ordered
+— tids ARE the version numbers, the eversion analog) recording the
+per-shard extents the write touched, and records per-shard acks.
+``completed_to(shard)`` is the max contiguous acked tid;
+``dirty_extents(shard)`` is the union of extents written past it —
+exactly what delta recovery must rebuild. ``trim`` drops entries every
+shard has completed (log bounded like the reference's
+osd_min_pg_log_entries window).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .extents import ExtentSet
+
+
+@dataclass
+class LogEntry:
+    """One client write (the pg_log_entry_t analog)."""
+
+    tid: int
+    oid: str
+    shard_extents: dict[int, ExtentSet] = field(default_factory=dict)
+
+
+class PGLog:
+    def __init__(self, n_shards: int) -> None:
+        self.n_shards = n_shards
+        self.entries: list[LogEntry] = []  # tid-ascending
+        self._acked: dict[int, set[int]] = {s: set() for s in range(n_shards)}
+        self._completed: dict[int, int] = {s: 0 for s in range(n_shards)}
+        self.tail = 0  # tids <= tail are trimmed
+
+    # -- write path hooks ----------------------------------------------
+    def append(
+        self, tid: int, oid: str, shard_extents: dict[int, ExtentSet]
+    ) -> None:
+        if self.entries and tid <= self.entries[-1].tid:
+            raise ValueError(f"non-monotonic log append: tid {tid}")
+        self.entries.append(
+            LogEntry(tid, oid, {s: es.copy() for s, es in shard_extents.items()})
+        )
+
+    def ack(self, shard: int, tid: int) -> None:
+        """A shard durably applied its sub-write for ``tid``."""
+        if tid <= self._completed[shard]:
+            return  # already covered (e.g. a post-recovery rollforward)
+        acked = self._acked[shard]
+        acked.add(tid)
+        # advance the contiguous frontier
+        c = self._completed[shard]
+        while (c + 1) in acked or self._is_gap(c + 1):
+            if (c + 1) in acked:
+                acked.discard(c + 1)
+            c += 1
+        self._completed[shard] = c
+
+    def _is_gap(self, tid: int) -> bool:
+        """Tids the log never saw (aborted writes) don't block the
+        frontier."""
+        if tid > (self.entries[-1].tid if self.entries else self.tail):
+            return False
+        if tid <= self.tail:
+            return True
+        return all(e.tid != tid for e in self.entries)
+
+    # -- recovery surface ----------------------------------------------
+    def completed_to(self, shard: int) -> int:
+        return self._completed[shard]
+
+    def head(self) -> int:
+        return self.entries[-1].tid if self.entries else self.tail
+
+    def dirty_extents(self, shard: int) -> dict[str, ExtentSet]:
+        """Per-object extents this shard is missing: everything written
+        past its contiguous frontier (the missing-set computation of
+        PGLog::merge_log, as extents instead of whole objects)."""
+        frontier = self._completed[shard]
+        out: dict[str, ExtentSet] = {}
+        for e in self.entries:
+            if e.tid <= frontier:
+                continue
+            es = e.shard_extents.get(shard)
+            if not es:
+                continue
+            acc = out.setdefault(e.oid, ExtentSet())
+            for start, end in es:
+                acc.insert(start, end - start)
+        return out
+
+    def mark_recovered(self, shard: int, up_to: int | None = None) -> None:
+        """Delta recovery finished: the shard now reflects every write
+        through ``up_to`` (default: the log head)."""
+        target = self.head() if up_to is None else up_to
+        self._completed[shard] = max(self._completed[shard], target)
+        self._acked[shard] = {
+            t for t in self._acked[shard] if t > target
+        }
+
+    def trim(self) -> int:
+        """Drop entries all shards have completed; returns new tail
+        (PGLog::trim)."""
+        floor = min(self._completed.values())
+        kept = [e for e in self.entries if e.tid > floor]
+        trimmed = len(self.entries) - len(kept)
+        self.entries = kept
+        self.tail = max(self.tail, floor)
+        return trimmed
+
+    def __len__(self) -> int:
+        return len(self.entries)

@@ -63,6 +63,8 @@ class RecoveryOp:
         self.recovered_bytes = 0
         self.read_bytes = 0
         self.error: Exception | None = None
+        # Optional per-shard extent restriction (delta recovery).
+        self.extent_override: dict[int, ExtentSet] | None = None
 
 
 class RecoveryBackend:
@@ -114,13 +116,21 @@ class RecoveryBackend:
                 op.state = RecoveryState.COMPLETE
         return op.state
 
-    def recover_object(self, oid: str, missing: set[int]) -> RecoveryOp:
+    def recover_object(
+        self,
+        oid: str,
+        missing: set[int],
+        extents: "dict[int, ExtentSet] | None" = None,
+    ) -> RecoveryOp:
         """Run the FSM to completion. Backends with a ``drain_until``
-        event loop (the networked one) are drained between states."""
+        event loop (the networked one) are drained between states.
+        ``extents`` restricts the rebuild per shard — the log-driven
+        delta-recovery path (see ``recover_from_log``)."""
         from ceph_tpu.utils import tracer
 
         drain = getattr(self.backend, "drain_until", None)
         op = self.open_recovery_op(oid, missing)
+        op.extent_override = extents
         with tracer.span("ec_recover", oid=oid, missing=sorted(missing)):
             while op.state is not RecoveryState.COMPLETE:
                 before = op.state
@@ -149,7 +159,17 @@ class RecoveryBackend:
         op.want = {}
         for shard in op.missing:
             ssize = self.sinfo.object_size_to_exact_shard_size(size, shard)
-            if ssize > 0:
+            if ssize <= 0:
+                continue
+            if op.extent_override is not None:
+                es = op.extent_override.get(shard, ExtentSet())
+                clipped = ExtentSet()
+                for start, end in es:
+                    if start < ssize:
+                        clipped.insert(start, min(end, ssize) - start)
+                if clipped:
+                    op.want[shard] = clipped
+            else:
                 op.want[shard] = ExtentSet([(0, ssize)])
         op.result = ShardExtentMap(self.sinfo)
         op.state = RecoveryState.READING
@@ -252,6 +272,21 @@ class RecoveryBackend:
             )
         if not op.pending_pushes:
             op.state = RecoveryState.COMPLETE
+
+    # -- log-driven delta recovery (PGLog missing-set replay) ----------
+    def recover_from_log(self, pglog, shard: int) -> dict[str, RecoveryOp]:
+        """Catch a lagging shard up from the op log: rebuild ONLY the
+        extents written past its contiguous frontier — the delta
+        recovery PGLog exists for, vs. full backfill (osd/PGLog.h
+        missing-set semantics). Marks the shard recovered on success."""
+        head = pglog.head()
+        ops: dict[str, RecoveryOp] = {}
+        for oid, extents in sorted(pglog.dirty_extents(shard).items()):
+            ops[oid] = self.recover_object(
+                oid, {shard}, extents={shard: extents}
+            )
+        pglog.mark_recovered(shard, head)
+        return ops
 
 
 # -- deep scrub ---------------------------------------------------------

@@ -269,7 +269,9 @@ class RMWPipeline:
         backend: ShardBackend,
         cache_lines: int | None = None,
         perf_name: str = "ec_rmw",
+        pglog=None,
     ) -> None:
+        self.pglog = pglog
         self.sinfo = sinfo
         self.codec = codec
         self.backend = backend
@@ -446,6 +448,12 @@ class RMWPipeline:
                 written.insert(shard, start, np.frombuffer(buf, np.uint8))
             txn.setattr(op.oid, HINFO_KEY, hinfo_bytes)
             txns.append((shard, txn))
+        if self.pglog is not None:
+            self.pglog.append(
+                op.tid,
+                op.oid,
+                {s: written.get_extent_set(s) for s in written.shards()},
+            )
         # build every txn before the first dispatch: a synchronous ack
         # (local stores) must see the complete written map
         for shard, txn in txns:
@@ -454,11 +462,26 @@ class RMWPipeline:
             )
 
     def _shard_ack(self, op: ClientOp, shard: int) -> None:
+        if self.pglog is not None:
+            self.pglog.ack(shard, op.tid)
         op.pending_shards.discard(shard)
         if not op.pending_shards:
             op.committed = True
             self.cache.write_done(op.cache_op, op.written)
             self._check_commit_order()
+
+    def on_shard_recovered(
+        self, shard: int, up_to_tid: int | None = None
+    ) -> None:
+        """Log-driven recovery rebuilt this shard's missed extents:
+        treat the lost sub-write acks as durable and let parked ops
+        commit — the rollforward of partially-committed EC writes
+        (pending_roll_forward semantics, ECCommon.h:500-503 + PGLog)."""
+        for tid, op in list(self._inflight.items()):
+            if up_to_tid is not None and tid > up_to_tid:
+                continue
+            if shard in op.pending_shards:
+                self._shard_ack(op, shard)
 
     def _check_commit_order(self) -> None:
         """Fire on_commit strictly in tid order (waiting_commit /
