@@ -10,5 +10,6 @@ plays the same role for the TPU pipeline tests.
 
 from .transaction import Op, OpKind, Transaction
 from .memstore import MemStore
+from .filestore import FileStore
 
-__all__ = ["MemStore", "Op", "OpKind", "Transaction"]
+__all__ = ["FileStore", "MemStore", "Op", "OpKind", "Transaction"]

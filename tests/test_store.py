@@ -1,33 +1,52 @@
-"""MemStore + Transaction semantics (the store_test.cc analog subset)."""
+"""Store + Transaction semantics, one suite over every backend (the
+store_test.cc pattern: the same assertions run over MemStore and
+FileStore, src/test/objectstore/store_test.cc)."""
+
+import os
+import struct
 
 import pytest
 
+from ceph_tpu.checksum.host import crc32c
 from ceph_tpu.pipeline.hashinfo import HashInfo
-from ceph_tpu.store import MemStore, Transaction
+from ceph_tpu.store import FileStore, MemStore, Transaction
 
 
-def test_write_read_roundtrip():
-    st = MemStore()
+@pytest.fixture(params=["memstore", "filestore"])
+def st(request, tmp_path):
+    if request.param == "memstore":
+        return MemStore()
+    return FileStore(str(tmp_path / "fs"))
+
+
+def journal_append(path, payload, crc=None):
+    """Append one journal record in FileStore's on-disk framing
+    (length + crc32c header); ``crc`` overrides for bad-CRC cases."""
+    if crc is None:
+        crc = crc32c(0xFFFFFFFF, payload)
+    with open(path, "ab") as jf:
+        jf.write(struct.pack("<II", len(payload), crc))
+        jf.write(payload)
+
+
+def test_write_read_roundtrip(st):
     st.queue_transactions(Transaction().write("o", 0, b"hello"))
     assert st.read("o") == b"hello"
     assert st.stat("o") == 5
 
 
-def test_write_extends_with_zero_fill():
-    st = MemStore()
+def test_write_extends_with_zero_fill(st):
     st.queue_transactions(Transaction().write("o", 8, b"xy"))
     assert st.read("o") == b"\0" * 8 + b"xy"
 
 
-def test_overwrite_middle():
-    st = MemStore()
+def test_overwrite_middle(st):
     st.queue_transactions(Transaction().write("o", 0, b"aaaaaaaa"))
     st.queue_transactions(Transaction().write("o", 2, b"BB"))
     assert st.read("o") == b"aaBBaaaa"
 
 
-def test_zero_and_truncate():
-    st = MemStore()
+def test_zero_and_truncate(st):
     st.queue_transactions(Transaction().write("o", 0, b"abcdefgh"))
     st.queue_transactions(Transaction().zero("o", 2, 3))
     assert st.read("o") == b"ab\0\0\0fgh"
@@ -37,21 +56,24 @@ def test_zero_and_truncate():
     assert st.read("o") == b"ab\0\0\0\0"
 
 
-def test_short_read_past_eof():
-    st = MemStore()
+def test_zero_extends(st):
+    st.queue_transactions(Transaction().write("o", 0, b"ab"))
+    st.queue_transactions(Transaction().zero("o", 4, 4))
+    assert st.read("o") == b"ab\0\0\0\0\0\0"
+
+
+def test_short_read_past_eof(st):
     st.queue_transactions(Transaction().write("o", 0, b"abc"))
     assert st.read("o", 2, 100) == b"c"
 
 
-def test_touch_creates_empty():
-    st = MemStore()
+def test_touch_creates_empty(st):
     st.queue_transactions(Transaction().touch("o"))
     assert st.exists("o")
     assert st.stat("o") == 0
 
 
-def test_remove():
-    st = MemStore()
+def test_remove(st):
     st.queue_transactions(Transaction().write("o", 0, b"x"))
     st.queue_transactions(Transaction().remove("o"))
     assert not st.exists("o")
@@ -59,15 +81,13 @@ def test_remove():
         st.read("o")
 
 
-def test_remove_then_recreate_in_one_txn():
-    st = MemStore()
+def test_remove_then_recreate_in_one_txn(st):
     st.queue_transactions(Transaction().write("o", 0, b"old"))
     st.queue_transactions(Transaction().remove("o").write("o", 0, b"new"))
     assert st.read("o") == b"new"
 
 
-def test_attrs_roundtrip_hashinfo():
-    st = MemStore()
+def test_attrs_roundtrip_hashinfo(st):
     hi = HashInfo(6)
     hi.append(0, {i: b"\x01" * 8 for i in range(6)})
     st.queue_transactions(
@@ -79,8 +99,7 @@ def test_attrs_roundtrip_hashinfo():
         st.getattr("o", "hinfo")
 
 
-def test_atomicity_failed_txn_leaves_no_state():
-    st = MemStore()
+def test_atomicity_failed_txn_leaves_no_state(st):
     st.queue_transactions(Transaction().write("o", 0, b"keep"))
     bad = Transaction().write("o", 0, b"clobber").remove("missing")
     with pytest.raises(FileNotFoundError):
@@ -88,8 +107,7 @@ def test_atomicity_failed_txn_leaves_no_state():
     assert st.read("o") == b"keep"  # first op rolled back too
 
 
-def test_ordered_multi_txn_batch():
-    st = MemStore()
+def test_ordered_multi_txn_batch(st):
     seq = st.queue_transactions(
         [
             Transaction().write("o", 0, b"v1"),
@@ -101,11 +119,71 @@ def test_ordered_multi_txn_batch():
     assert st.queue_transactions(Transaction().touch("p")) == 2
 
 
-def test_missing_object_errors():
-    st = MemStore()
+def test_missing_object_errors(st):
     with pytest.raises(FileNotFoundError):
         st.stat("nope")
     with pytest.raises(FileNotFoundError):
         st.getattr("nope", "a")
     with pytest.raises(FileNotFoundError):
         st.queue_transactions(Transaction().remove("nope"))
+
+
+def test_list_objects(st):
+    st.queue_transactions(Transaction().touch("b").touch("a"))
+    assert st.list_objects() == ["a", "b"]
+
+
+# -- FileStore-only: durability across process lifetimes ----------------
+
+
+def test_filestore_persists_across_reopen(tmp_path):
+    root = str(tmp_path / "fs")
+    st = FileStore(root)
+    st.queue_transactions(
+        Transaction().write("obj/1", 0, b"durable").setattr("obj/1", "a", b"v")
+    )
+    st2 = FileStore(root)
+    assert st2.read("obj/1") == b"durable"
+    assert st2.getattr("obj/1", "a") == b"v"
+    assert st2.list_objects() == ["obj/1"]
+
+
+def test_filestore_replays_journal_on_crash(tmp_path):
+    """A transaction journaled but not applied (crash between fsync and
+    apply) must be recovered on the next open — the WAL contract."""
+    root = str(tmp_path / "fs")
+    st = FileStore(root)
+    st.queue_transactions(Transaction().write("o", 0, b"v1"))
+    # Simulate the crash: journal an update by hand, never apply it.
+    journal_append(st.journal_path, Transaction().write("o", 0, b"v2").to_bytes())
+    st2 = FileStore(root)
+    assert st2.read("o") == b"v2"
+    assert not os.path.exists(st2.journal_path)  # retired after replay
+
+
+def test_filestore_discards_torn_journal_tail(tmp_path):
+    """A half-written (bad-CRC) journal record is discarded; records
+    before it still replay."""
+    root = str(tmp_path / "fs")
+    st = FileStore(root)
+    journal_append(st.journal_path, Transaction().write("o", 0, b"good").to_bytes())
+    journal_append(
+        st.journal_path,
+        Transaction().write("o", 0, b"evil").to_bytes(),
+        crc=0xDEADBEEF,  # wrong crc: torn record
+    )
+    st2 = FileStore(root)
+    assert st2.read("o") == b"good"
+
+
+def test_filestore_replay_is_idempotent(tmp_path):
+    """Crash AFTER apply but before journal retirement: replay re-applies
+    the same transaction; converges (at-least-once semantics)."""
+    root = str(tmp_path / "fs")
+    st = FileStore(root)
+    st.queue_transactions(Transaction().write("o", 0, b"x"))
+    txn = Transaction().remove("o")
+    journal_append(st.journal_path, txn.to_bytes())
+    st._apply(txn)  # applied, then "crash" before retire
+    st2 = FileStore(root)  # replays REMOVE of already-gone object: no-op
+    assert not st2.exists("o")
