@@ -1,0 +1,35 @@
+"""Cluster control plane — the OSDMap / monitor / client tier.
+
+The reference's control plane (SURVEY.md §2.4, §3.4): an epoch-
+versioned cluster map (src/osd/OSDMap.h) published by a monitor
+authority (src/mon/OSDMonitor.cc) and consumed by clients that target
+ops via the map (src/osdc/Objecter.cc). This package is the analog:
+
+- ``osdmap``:   OSDMap + Incremental — devices, pools, EC profiles,
+                up/down/in/out, pg→acting arithmetic with EC holes.
+- ``monitor``:  the map authority — commands, profile validation,
+                failure reports, subscriptions, incremental catch-up.
+- ``paxos``:    quorum-replicated commit for the monitor store.
+- ``osd_daemon`` / ``objecter``: the data-plane daemon serving client
+                ops and the map-aware resending client.
+"""
+
+from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec, SHARD_NONE
+from .monitor import CommandError, Monitor
+from .objecter import IoCtx, NoPrimary, Objecter, RadosClient
+from .osd_daemon import OSDDaemon
+
+__all__ = [
+    "CommandError",
+    "Incremental",
+    "IoCtx",
+    "Monitor",
+    "NoPrimary",
+    "OSDDaemon",
+    "OSDInfo",
+    "OSDMap",
+    "Objecter",
+    "PoolSpec",
+    "RadosClient",
+    "SHARD_NONE",
+]

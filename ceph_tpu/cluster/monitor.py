@@ -1,0 +1,308 @@
+"""Monitor — the cluster-map authority (src/mon/OSDMonitor.cc).
+
+Mirrors the control-plane contract of the reference monitor:
+
+- **Commands** mutate the map through validated proposals:
+  ``osd_erasure_code_profile_set`` validates a profile by actually
+  instantiating the codec plugin (OSDMonitor::parse_erasure_code_profile,
+  mon/OSDMonitor.cc:7714 → ErasureCodePluginRegistry::factory);
+  ``osd_pool_create`` binds a pool to a validated profile and derives
+  k/m from the live codec (prepare_pool_crush_rule, :7885).
+- **Failure detection**: OSDs report peers dead
+  (``report_failure``); the monitor marks an OSD down only after
+  reports from ``mon_osd_min_down_reporters`` *distinct* reporters
+  (OSDMonitor::check_failure semantics), and auto-outs it after
+  ``mon_osd_down_out_interval`` seconds down (tick-driven, injected
+  clock for tests).
+- **Publication**: every committed change produces one
+  ``Incremental``; subscribers are notified with the new map, and
+  laggards catch up via ``get_incrementals(since)`` — full-map
+  fallback when history has been trimmed (the monc subscription
+  protocol shape).
+
+Commits go through a pluggable ``commit_fn`` so a Paxos quorum
+(``cluster.paxos``) can replicate the incremental stream; standalone,
+commits apply locally (a quorum of one).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from collections.abc import Callable
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.utils import config
+
+from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec
+
+
+class CommandError(Exception):
+    """A monitor command was rejected (EINVAL-style)."""
+
+
+class Monitor:
+    """Single map authority (quorum-of-one unless ``commit_fn``)."""
+
+    def __init__(
+        self,
+        initial: OSDMap | None = None,
+        commit_fn: Callable[[Incremental], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.osdmap = initial or OSDMap()
+        self._commit_fn = commit_fn
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._subscribers: list[Callable[[OSDMap], None]] = []
+        #: incremental history for catch-up, keyed by produced epoch
+        self._incrementals: dict[int, Incremental] = {}
+        #: target -> set of reporter ids (pending failure evidence)
+        self._failure_reports: dict[int, set[int]] = {}
+        #: osd id -> monotonic time it went down (for auto-out)
+        self._down_since: dict[int, float] = {}
+        self._next_pool_id = 1
+        #: committed maps awaiting subscriber delivery. Delivery
+        #: happens OUTSIDE the monitor lock (``_flush``): subscribers
+        #: do real work (an OSD daemon may drive recovery IO on a map
+        #: change) and must not stall the control plane or deadlock
+        #: by re-entering it.
+        self._pending_notify: list[OSDMap] = []
+        self._cmd_depth = 0
+
+    @contextmanager
+    def _command(self):
+        """Lock scope for one public command. On exit of the OUTERMOST
+        command (osd_pool_create calls osd_erasure_code_profile_set
+        internally), queued map notifications are delivered with the
+        lock released."""
+        self._lock.acquire()
+        self._cmd_depth += 1
+        try:
+            yield
+        finally:
+            self._cmd_depth -= 1
+            depth = self._cmd_depth
+            self._lock.release()
+            if depth == 0:
+                self._flush()
+
+    # -- commit path ----------------------------------------------------
+    def _propose(self, **fields) -> OSDMap:
+        """Build + commit one incremental; returns the new map. Caller
+        must hold the lock and call ``_flush`` after releasing it."""
+        incr = Incremental(epoch=self.osdmap.epoch + 1, **fields)
+        if self._commit_fn is not None:
+            self._commit_fn(incr)  # quorum may raise; nothing applied
+        self.osdmap = self.osdmap.apply(incr)
+        self._incrementals[incr.epoch] = incr
+        self._pending_notify.append(self.osdmap)
+        return self.osdmap
+
+    def _flush(self) -> None:
+        """Deliver queued map notifications without holding the lock.
+        Epoch order is preserved by popping under the lock; consumers
+        racing on separate threads must tolerate an old epoch arriving
+        late (the daemon guards on epoch)."""
+        while True:
+            with self._lock:
+                if not self._pending_notify:
+                    return
+                m = self._pending_notify.pop(0)
+                subs = list(self._subscribers)
+            for fn in subs:
+                fn(m)
+
+    # -- subscriptions (monc analog) ------------------------------------
+    def subscribe(self, fn: Callable[[OSDMap], None]) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+            current = self.osdmap
+        fn(current)
+
+    def get_incrementals(self, since: int) -> list[Incremental] | None:
+        """Deltas from epoch ``since`` (exclusive) to current; None if
+        history no longer reaches back that far (send the full map)."""
+        with self._lock:
+            out = []
+            for e in range(since + 1, self.osdmap.epoch + 1):
+                incr = self._incrementals.get(e)
+                if incr is None:
+                    return None
+                out.append(incr)
+            return out
+
+    def trim_history(self, keep: int = 500) -> None:
+        with self._lock:
+            floor = self.osdmap.epoch - keep
+            for e in [e for e in self._incrementals if e <= floor]:
+                del self._incrementals[e]
+
+    # -- device lifecycle -----------------------------------------------
+    def osd_crush_add(
+        self, osd: int, weight: float = 1.0, zone: str = ""
+    ) -> OSDMap:
+        """Register a device in the crush tree (ceph osd crush add)."""
+        with self._command():
+            prev = self.osdmap.osds.get(osd)
+            info = OSDInfo(
+                osd, weight, zone,
+                up=prev.up if prev else False,
+                in_=prev.in_ if prev else False,
+                addr=prev.addr if prev else None,
+            )
+            return self._propose(new_osds=(info,))
+
+    def osd_boot(self, osd: int, addr: tuple[str, int]) -> OSDMap:
+        """An OSD came up and announced its address (MOSDBoot)."""
+        with self._command():
+            prev = self.osdmap.osds.get(osd)
+            if prev is None:
+                raise CommandError(f"osd.{osd} not in crush map")
+            info = OSDInfo(
+                osd, prev.weight, prev.zone, up=True, in_=True, addr=addr
+            )
+            self._failure_reports.pop(osd, None)
+            self._down_since.pop(osd, None)
+            return self._propose(new_osds=(info,))
+
+    def osd_down(self, osd: int) -> OSDMap:
+        with self._command():
+            self._check_osd(osd)
+            self._down_since.setdefault(osd, self._clock())
+            self._failure_reports.pop(osd, None)
+            return self._propose(down=(osd,))
+
+    def osd_out(self, osd: int) -> OSDMap:
+        with self._command():
+            self._check_osd(osd)
+            return self._propose(out=(osd,))
+
+    def osd_in(self, osd: int) -> OSDMap:
+        with self._command():
+            self._check_osd(osd)
+            return self._propose(in_=(osd,))
+
+    def osd_reweight(self, osd: int, weight: float) -> OSDMap:
+        with self._command():
+            prev = self._check_osd(osd)
+            if weight < 0:
+                raise CommandError("weight must be >= 0")
+            from dataclasses import replace
+
+            return self._propose(new_osds=(replace(prev, weight=weight),))
+
+    def _check_osd(self, osd: int) -> OSDInfo:
+        info = self.osdmap.osds.get(osd)
+        if info is None:
+            raise CommandError(f"osd.{osd} does not exist")
+        return info
+
+    # -- failure detection (OSDMonitor::check_failure) -------------------
+    def report_failure(self, reporter: int, target: int) -> OSDMap | None:
+        """Peer-failure evidence. Marks the target down once
+        ``mon_osd_min_down_reporters`` distinct reporters agree; a
+        report about an already-down or unknown OSD is ignored."""
+        with self._command():
+            info = self.osdmap.osds.get(target)
+            if info is None or not info.up or reporter == target:
+                return None
+            reporters = self._failure_reports.setdefault(target, set())
+            reporters.add(reporter)
+            if len(reporters) < config.get("mon_osd_min_down_reporters"):
+                return None
+            del self._failure_reports[target]
+            self._down_since[target] = self._clock()
+            return self._propose(down=(target,))
+
+    def tick(self) -> OSDMap | None:
+        """Periodic maintenance: auto-out OSDs down longer than
+        ``mon_osd_down_out_interval`` (data starts rebalancing)."""
+        with self._command():
+            horizon = self._clock() - config.get("mon_osd_down_out_interval")
+            expired = [
+                osd for osd, t in self._down_since.items()
+                if t <= horizon and self.osdmap.osds[osd].in_
+            ]
+            if not expired:
+                return None
+            for osd in expired:
+                del self._down_since[osd]
+            return self._propose(out=tuple(expired))
+
+    # -- EC profiles & pools (OSDMonitor::parse_erasure_code_profile) ----
+    def osd_erasure_code_profile_set(
+        self, name: str, profile: dict[str, str], force: bool = False
+    ) -> OSDMap:
+        """Validate by instantiating the plugin, then commit. Changing
+        an existing profile requires ``force`` (it would silently
+        change placement math for existing pools — same guard as the
+        reference)."""
+        with self._command():
+            if name in self.osdmap.profiles and not force:
+                if self.osdmap.profiles[name] != profile:
+                    raise CommandError(
+                        f"profile {name!r} exists; --force to overwrite"
+                    )
+                return self.osdmap
+            self._validate_profile(profile)
+            return self._propose(
+                new_profiles=((name, tuple(sorted(profile.items()))),)
+            )
+
+    @staticmethod
+    def _validate_profile(profile: dict[str, str]):
+        plugin = profile.get("plugin", config.get("erasure_code_default_plugin"))
+        try:
+            codec = registry.factory(plugin, dict(profile))
+        except Exception as e:
+            raise CommandError(f"invalid erasure-code profile: {e}") from e
+        return plugin, codec
+
+    def osd_pool_create(
+        self,
+        name: str,
+        pg_num: int,
+        profile_name: str = "",
+        distinct_zones: bool = False,
+    ) -> OSDMap:
+        with self._command():
+            if name in self.osdmap.pools:
+                raise CommandError(f"pool {name!r} already exists")
+            if pg_num <= 0:
+                raise CommandError("pg_num must be positive")
+            if not profile_name:
+                profile_name = "default"
+                if profile_name not in self.osdmap.profiles:
+                    prof = dict(
+                        kv.split("=")
+                        for kv in config.get(
+                            "erasure_code_default_profile"
+                        ).split()
+                    )
+                    self.osd_erasure_code_profile_set(profile_name, prof)
+            profile = self.osdmap.profiles.get(profile_name)
+            if profile is None:
+                raise CommandError(f"no such profile: {profile_name!r}")
+            plugin, codec = self._validate_profile(profile)
+            k = codec.get_data_chunk_count()
+            size = codec.get_chunk_count()
+            spec = PoolSpec(
+                name=name,
+                pool_id=self._next_pool_id,
+                pg_num=pg_num,
+                profile_name=profile_name,
+                k=k,
+                m=size - k,
+                plugin=plugin,
+                distinct_zones=distinct_zones,
+            )
+            self._next_pool_id += 1
+            return self._propose(new_pools=(spec,))
+
+    def osd_pool_rm(self, name: str) -> OSDMap:
+        with self._command():
+            if name not in self.osdmap.pools:
+                raise CommandError(f"no such pool: {name!r}")
+            return self._propose(removed_pools=(name,))

@@ -1,0 +1,193 @@
+"""Objecter + librados-style client (src/osdc/Objecter.cc,
+src/librados/IoCtxImpl.cc).
+
+The client side of the money path (SURVEY.md §3.1): ops target the
+object's primary through the current OSDMap (``_calc_target``,
+osdc/Objecter.cc:2441), travel as ``OSDOp`` messages, and are
+**resent** whenever the answer is retryable: wrong-primary ``eagain``,
+a dead connection, or a map change that moves the object
+(``_scan_requests`` resend, osdc/Objecter.cc:2127). Retries refresh
+the map first and back off exponentially; terminal errors surface as
+exceptions (FileNotFoundError for enoent, IOError for eio).
+
+``RadosClient``/``IoCtx`` mirror the librados surface
+(rados_write → IoCtxImpl::write → op_submit, librados_c.cc:1308):
+
+    client = RadosClient(mon)
+    io = client.open_ioctx("ecpool")
+    io.write("obj", b"payload")
+    io.read("obj")
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ceph_tpu.msg.messages import OSDOp, OSDOpReply
+from ceph_tpu.msg.messenger import Connection, Messenger
+
+from .osdmap import SHARD_NONE
+
+
+class NoPrimary(Exception):
+    """No live primary for the object after retries (cluster too
+    degraded to serve — the reference client would block forever)."""
+
+
+class Objecter:
+    """Map-aware op targeting + resend. ``monitor`` provides the map
+    (in-process monc); transport is the framed messenger."""
+
+    def __init__(
+        self,
+        monitor,
+        max_attempts: int = 8,
+        op_timeout: float = 30.0,
+        backoff: float = 0.05,
+    ) -> None:
+        self.monitor = monitor
+        self.max_attempts = max_attempts
+        self.op_timeout = op_timeout
+        self.backoff = backoff
+        self.messenger = Messenger("client")
+        self.messenger.set_dispatcher(self._dispatch)
+        self._conns: dict[tuple[str, int], Connection] = {}
+        self._tids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._waiting: dict[int, dict] = {}  # tid -> {event, reply}
+        #: ops resent so far (visible to tests: the resend contract)
+        self.resends = 0
+
+    # -- transport ------------------------------------------------------
+    def _conn(self, addr: tuple[str, int]) -> Connection:
+        with self._lock:
+            conn = self._conns.get(addr)
+        if conn is not None and conn.alive:
+            return conn
+        conn = self.messenger.connect(addr)
+        with self._lock:
+            self._conns[addr] = conn
+        return conn
+
+    def _dispatch(self, conn: Connection, msg) -> None:
+        if not isinstance(msg, OSDOpReply):
+            return
+        with self._lock:
+            entry = self._waiting.get(msg.tid)
+        if entry is not None:
+            entry["reply"] = msg
+            entry["event"].set()
+
+    # -- op submission (the op_submit → _calc_target loop) --------------
+    def submit(
+        self,
+        pool: str,
+        oid: str,
+        op: str,
+        offset: int = 0,
+        length: int = 0,
+        data: bytes = b"",
+    ) -> OSDOpReply:
+        last = "no attempt made"
+        for attempt in range(self.max_attempts):
+            if attempt:
+                self.resends += 1
+                time.sleep(self.backoff * (2 ** (attempt - 1)))
+            osdmap = self.monitor.osdmap  # refresh before each attempt
+            try:
+                primary = osdmap.primary(pool, oid)
+            except KeyError as e:
+                raise FileNotFoundError(str(e)) from None
+            if primary == SHARD_NONE:
+                last = "no live primary"
+                continue
+            addr = osdmap.get_addr(primary)
+            if addr is None:
+                last = f"osd.{primary} has no address"
+                continue
+            tid = next(self._tids)
+            entry = {"event": threading.Event(), "reply": None}
+            with self._lock:
+                self._waiting[tid] = entry
+            try:
+                self._conn(addr).send(
+                    OSDOp(tid, osdmap.epoch, pool, oid, op,
+                          offset, length, data)
+                )
+                if not entry["event"].wait(self.op_timeout):
+                    last = f"osd.{primary} timed out"
+                    continue
+            except (ConnectionError, OSError):
+                last = f"osd.{primary} connection failed"
+                with self._lock:
+                    self._conns.pop(addr, None)
+                continue
+            finally:
+                with self._lock:
+                    self._waiting.pop(tid, None)
+            reply: OSDOpReply = entry["reply"]
+            if reply.error == "eagain":
+                last = f"osd.{primary} not primary (its epoch {reply.epoch})"
+                continue
+            if reply.error == "enoent":
+                raise FileNotFoundError(f"{pool}/{oid}")
+            if reply.error == "eio":
+                raise IOError(reply.data.decode() or f"eio on {pool}/{oid}")
+            return reply
+        raise NoPrimary(
+            f"{op} {pool}/{oid}: gave up after {self.max_attempts} "
+            f"attempts ({last})"
+        )
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
+
+
+class IoCtx:
+    """Per-pool op facade (librados IoCtx)."""
+
+    def __init__(self, objecter: Objecter, pool: str) -> None:
+        self.objecter = objecter
+        self.pool = pool
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> int:
+        """Write bytes at offset; returns the new object size."""
+        return self.objecter.submit(
+            self.pool, oid, "write", offset=offset, data=bytes(data)
+        ).size
+
+    def write_full(self, oid: str, data: bytes) -> int:
+        try:
+            self.remove(oid)
+        except FileNotFoundError:
+            pass
+        return self.write(oid, data, 0)
+
+    def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+        return self.objecter.submit(
+            self.pool, oid, "read", offset=offset, length=length
+        ).data
+
+    def stat(self, oid: str) -> int:
+        return self.objecter.submit(self.pool, oid, "stat").size
+
+    def remove(self, oid: str) -> None:
+        self.objecter.submit(self.pool, oid, "remove")
+
+
+class RadosClient:
+    """Cluster handle (rados_t): monitor session + shared Objecter."""
+
+    def __init__(self, monitor, **objecter_kw) -> None:
+        self.monitor = monitor
+        self.objecter = Objecter(monitor, **objecter_kw)
+
+    def open_ioctx(self, pool: str) -> IoCtx:
+        if pool not in self.monitor.osdmap.pools:
+            raise FileNotFoundError(f"no such pool: {pool!r}")
+        return IoCtx(self.objecter, pool)
+
+    def shutdown(self) -> None:
+        self.objecter.shutdown()

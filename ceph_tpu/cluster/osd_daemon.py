@@ -1,0 +1,465 @@
+"""OSD daemon — the data-plane node (src/osd/OSD.cc + PrimaryLogPG).
+
+One ``OSDDaemon`` is one storage node: a local object store, a
+messenger endpoint, and the current OSDMap. It plays both reference
+roles:
+
+- **replica**: serves ECSubWrite/ECSubRead from peer primaries against
+  its local store (handle_sub_write/read, osd/ECBackend.cc:912,998).
+- **primary**: serves client ``OSDOp``s for objects it leads. Per-PG
+  state mirrors the reference's PG objects: each (pool, pg) gets an
+  ``RMWPipeline`` + ``ReadPipeline`` bound to a ``_PGBackend`` that
+  routes shard i of the acting set to the right peer (itself included)
+  — the ECSwitch-ctor wiring (osd/ECSwitch.h:36-48) resolved through
+  the osdmap instead of static config.
+
+Map flow: daemons subscribe to the monitor in-process (the MOSDMap
+push channel collapsed to a callback — the wire format exists in
+``cluster.osdmap`` serialization; transporting it is deployment
+plumbing, not protocol). On a map change, PGs whose acting set changed
+are dropped and lazily rebuilt; a NEW primary recovers per-object
+state (size, cumulative crcs) from the OI_KEY/HINFO_KEY attrs its
+local shard stores carry (the object_info_t takeover path).
+
+Wrong-primary requests answer ``eagain`` + the daemon's epoch, and the
+client re-targets (Objecter resend contract, osdc/Objecter.cc:2127).
+
+Client ops are serialized by a daemon op lock (the reference serializes
+per-PG via op queues; the mClock scheduler seam slots in here).
+Peer-failure evidence flows to the monitor via ``report_failure``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ceph_tpu.msg.messages import (
+    ECSubRead,
+    ECSubReadReply,
+    ECSubWrite,
+    ECSubWriteReply,
+    OSDOp,
+    OSDOpReply,
+    Ping,
+    Pong,
+)
+from ceph_tpu.msg.messenger import Connection, Messenger
+from ceph_tpu.msg.shard_server import NetShardBackend
+from ceph_tpu.codecs import registry
+from ceph_tpu.pipeline.extents import ExtentSet
+from ceph_tpu.pipeline.hashinfo import HashInfo
+from ceph_tpu.pipeline.pglog import PGLog
+from ceph_tpu.pipeline.read import ReadPipeline, ShardReadError
+from ceph_tpu.pipeline.recovery import RecoveryBackend
+from ceph_tpu.pipeline.rmw import (
+    HINFO_KEY,
+    OI_KEY,
+    SI_KEY,
+    RMWPipeline,
+    ShardBackend,
+)
+from ceph_tpu.pipeline.stripe import StripeInfo
+from ceph_tpu.store import MemStore
+
+from .osdmap import OSDMap, SHARD_NONE
+
+
+class _AnyShardStores(dict):
+    """shard-id → store mapping that answers EVERY key with the
+    daemon's one store: an OSD holds whichever logical shard the
+    acting set assigns it, keyed on disk by oid alone."""
+
+    def __init__(self, store) -> None:
+        super().__init__()
+        self._store = store
+
+    def __missing__(self, key):
+        return self._store
+
+
+class _PGBackend:
+    """ShardBackend surface bound to one PG's acting set: shard i
+    routes to acting[i] — local store or peer sub-op (the per-PG
+    ECBackend dispatch seam)."""
+
+    def __init__(self, daemon: "OSDDaemon", acting: list[int]) -> None:
+        self.daemon = daemon
+        self.acting = list(acting)
+        #: positions being caught up from the log: routable for
+        #: recovery PUSHES but excluded from avail (reads/writes must
+        #: not trust them until the replay completes)
+        self.recovering: set[int] = set()
+
+    def avail_shards(self) -> set[int]:
+        net_up = self.daemon.peers.avail_shards() | {self.daemon.osd_id}
+        return {
+            i
+            for i, osd in enumerate(self.acting)
+            if osd != SHARD_NONE and osd in net_up
+            and i not in self.recovering
+        }
+
+    def read_shard_async(self, shard, oid, extents, cb) -> None:
+        osd = self.acting[shard]
+        if osd == SHARD_NONE or (
+            osd == self.daemon.osd_id
+            and self.daemon._misplaced(oid, shard)
+        ):
+            self.daemon.peers._inbox.put(
+                lambda: cb(shard, ShardReadError(shard, oid))
+            )
+        elif osd == self.daemon.osd_id:
+            self.daemon.local.read_shard_async(
+                self.daemon.osd_id, oid, extents,
+                lambda _s, res: cb(shard, res),
+            )
+        else:
+            self.daemon.peers.read_shard_async(
+                osd, oid, extents, lambda _s, res: cb(shard, res),
+                logical=shard,
+            )
+
+    def read_shard(self, shard, oid, extents):
+        osd = self.acting[shard]
+        if osd == self.daemon.osd_id:
+            if self.daemon._misplaced(oid, shard):
+                raise ShardReadError(shard, oid, kind="misplaced")
+            return self.daemon.local.read_shard(
+                self.daemon.osd_id, oid, extents
+            )
+        return self.daemon.peers.read_shard(
+            osd, oid, extents, logical=shard
+        )
+
+    def submit_shard_txn(self, shard, txn, ack) -> None:
+        osd = self.acting[shard]
+        if osd == SHARD_NONE:
+            return  # parked: recovery's problem once the shard returns
+        if osd == self.daemon.osd_id:
+            self.daemon.local.submit_shard_txn(self.daemon.osd_id, txn, ack)
+        else:
+            self.daemon.peers.submit_shard_txn(osd, txn, ack)
+
+    def drain_until(self, pred, timeout: float = 30.0) -> None:
+        self.daemon.peers.drain_until(pred, timeout)
+
+
+class _PG:
+    """Primary-side state for one placement group. Holds the full
+    per-PG pipeline stack the reference's PG object holds: RMW, reads,
+    the op log (PGLog — the recovery journal), and a RecoveryBackend
+    for log-driven catch-up of returning members."""
+
+    def __init__(self, daemon: "OSDDaemon", pool: str, pg: int,
+                 raw: list[int], acting: list[int]) -> None:
+        spec = daemon.osdmap.pools[pool]
+        profile = dict(daemon.osdmap.profiles[spec.profile_name])
+        self.raw = list(raw)        # CRUSH membership (rebalance id)
+        self.acting = list(acting)  # raw with down members as holes
+        self.codec = registry.factory(spec.plugin, profile)
+        chunk = daemon.chunk_size
+        self.sinfo = StripeInfo(spec.k, spec.m, spec.k * chunk)
+        self.backend = _PGBackend(daemon, acting)
+        self.pglog = PGLog(spec.k + spec.m)
+        self.rmw = RMWPipeline(
+            self.sinfo, self.codec, self.backend,
+            perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.rmw",
+            pglog=self.pglog,
+        )
+        self.reads = ReadPipeline(
+            self.sinfo, self.codec, self.backend,
+            lambda oid: daemon._object_size(self, oid),
+            perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.read",
+        )
+        self.recovery = RecoveryBackend(
+            self.sinfo, self.codec, self.backend,
+            lambda oid: daemon._object_size(self, oid),
+            self.rmw.hinfo,
+            perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.recovery",
+        )
+
+
+class OSDDaemon:
+    """One storage daemon: store + messenger + per-PG pipelines."""
+
+    def __init__(
+        self,
+        osd_id: int,
+        monitor,
+        store=None,
+        chunk_size: int = 4096,
+        op_timeout: float = 15.0,
+    ) -> None:
+        self.osd_id = osd_id
+        self.monitor = monitor
+        self.store = store if store is not None else MemStore(f"osd.{osd_id}")
+        self.chunk_size = chunk_size
+        self.op_timeout = op_timeout
+        self.local = ShardBackend(_AnyShardStores(self.store))
+        self.peers = NetShardBackend({})
+        self.osdmap: OSDMap = monitor.osdmap
+        self.messenger = Messenger(f"osd.{osd_id}")
+        self.messenger.set_dispatcher(self._dispatch)
+        self.addr: tuple[str, int] | None = None
+        self._pgs: dict[tuple[str, int], _PG] = {}
+        self._op_lock = threading.Lock()   # serializes client ops
+        self._pg_lock = threading.Lock()   # guards _pgs + peer addrs
+        self._stopped = False
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self.addr = self.messenger.bind(host, port)
+        self.monitor.osd_boot(self.osd_id, self.addr)
+        self.monitor.subscribe(self._on_map)
+        return self.addr
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.peers.shutdown()
+        self.messenger.shutdown()
+
+    # -- map handling ---------------------------------------------------
+    def _on_map(self, osdmap: OSDMap) -> None:
+        if self._stopped:
+            return
+        to_recover: list[tuple[_PG, list[int]]] = []
+        with self._pg_lock:
+            if osdmap.epoch < self.osdmap.epoch:
+                return  # late delivery from a racing notifier thread
+            self.osdmap = osdmap
+            for osd, info in osdmap.osds.items():
+                if osd == self.osd_id:
+                    continue
+                if info.up and info.addr:
+                    if self.peers.addrs.get(osd) != info.addr:
+                        self.peers.set_addr(osd, info.addr)
+                    else:
+                        # the map says it's up: a locally observed
+                        # transient failure must not exclude it forever
+                        self.peers.down_shards.discard(osd)
+                else:
+                    self.peers.down_shards.add(osd)
+            for key, pg in list(self._pgs.items()):
+                pool, pgid = key
+                spec = osdmap.pools.get(pool)
+                if spec is None or osdmap.pg_to_raw(pool, pgid) != pg.raw:
+                    # membership changed: data must MOVE (backfill);
+                    # drop the PG — until backfill lands, reads fail
+                    # cleanly via the misplaced-shard guard
+                    del self._pgs[key]
+                    continue
+                new_acting = osdmap.pg_to_up_acting(pool, pgid)
+                if new_acting == pg.acting:
+                    continue
+                # same members, liveness flipped: heal in place. A
+                # member that RETURNED is behind — it joins in
+                # ``recovering`` state (pushes route to it, but reads
+                # and writes don't trust it) until the log replay
+                # completes; only then does it become available.
+                healed = [
+                    i for i, osd in enumerate(new_acting)
+                    if osd != SHARD_NONE and pg.acting[i] == SHARD_NONE
+                ]
+                pg.acting[:] = new_acting
+                pg.backend.acting[:] = new_acting
+                pg.backend.recovering.update(healed)
+                if healed:
+                    to_recover.append((pg, healed))
+        # drive recovery OUTSIDE the pg lock (it does IO + drains)
+        for pg, healed in to_recover:
+            for shard in healed:
+                self._catch_up_shard(pg, shard)
+
+    def _catch_up_shard(self, pg: _PG, shard: int) -> None:
+        """Replay the op log onto a returned member until it is clean
+        (writes racing the replay append new dirty entries — loop),
+        then admit it to the acting set. On failure the position
+        reverts to a hole; the next map change retries."""
+        try:
+            for _ in range(8):
+                pg.recovery.recover_from_log(pg.pglog, shard)
+                if not pg.pglog.dirty_extents(shard) and not (
+                    pg.pglog.dirty_deletes(shard)
+                ):
+                    break
+            pg.backend.recovering.discard(shard)
+            pg.rmw.on_shard_recovered(shard)
+        except Exception:
+            with self._pg_lock:
+                pg.acting[shard] = SHARD_NONE
+                pg.backend.acting[shard] = SHARD_NONE
+                pg.backend.recovering.discard(shard)
+
+    def _get_pg(self, pool: str, pgid: int) -> _PG:
+        with self._pg_lock:
+            pg = self._pgs.get((pool, pgid))
+            if pg is None:
+                raw = self.osdmap.pg_to_raw(pool, pgid)
+                acting = self.osdmap.pg_to_up_acting(pool, pgid)
+                pg = _PG(self, pool, pgid, raw, acting)
+                self._pgs[(pool, pgid)] = pg
+            return pg
+
+    # -- object-info recovery (new-primary takeover) --------------------
+    def _object_size(self, pg: _PG, oid: str) -> int:
+        size = pg.rmw.object_size(oid)
+        if size:
+            return size
+        try:
+            size = int(self.store.getattr(oid, OI_KEY).decode())
+        except (FileNotFoundError, KeyError):
+            return 0
+        hinfo = None
+        try:
+            hinfo = HashInfo.from_bytes(self.store.getattr(oid, HINFO_KEY))
+        except (FileNotFoundError, KeyError, ValueError):
+            pass
+        pg.rmw.prime_object(oid, size, hinfo)
+        return size
+
+    # -- dispatch -------------------------------------------------------
+    def _dispatch(self, conn: Connection, msg) -> None:
+        if isinstance(msg, Ping):
+            conn.send(Pong(msg.tid, self.osd_id))
+        elif isinstance(msg, ECSubWrite):
+            self.local.submit_shard_txn(
+                self.osd_id,
+                msg.txn,
+                lambda: conn.send(ECSubWriteReply(msg.tid, msg.shard)),
+            )
+        elif isinstance(msg, ECSubRead):
+            self._handle_sub_read(conn, msg)
+        elif isinstance(msg, OSDOp):
+            self._handle_client_op(conn, msg)
+
+    def _handle_sub_read(self, conn: Connection, msg: ECSubRead) -> None:
+        def reply(_shard, result) -> None:
+            if isinstance(result, Exception):
+                kind = getattr(result, "kind", "eio")
+                conn.send(ECSubReadReply(msg.tid, msg.shard, error=kind))
+            else:
+                offsets = sorted(result)
+                conn.send(
+                    ECSubReadReply(
+                        msg.tid, msg.shard, offsets,
+                        [bytes(result[o]) for o in offsets],
+                    )
+                )
+
+        if msg.logical is not None and self._misplaced(msg.oid, msg.logical):
+            conn.send(ECSubReadReply(msg.tid, msg.shard, error="misplaced"))
+            return
+        self.local.read_shard_async(
+            self.osd_id, msg.oid,
+            ExtentSet((s, e) for s, e in msg.extents), reply,
+        )
+
+    def _misplaced(self, oid: str, logical: int) -> bool:
+        """True when this store's bytes belong to a DIFFERENT logical
+        shard than the caller expects (post-remap, pre-backfill): the
+        SI attr travels with every sub-write exactly so this check can
+        turn would-be silent corruption into a clean shard error."""
+        try:
+            held = int(self.store.getattr(oid, SI_KEY).decode())
+        except (FileNotFoundError, KeyError, ValueError):
+            return False  # absent object/attr: plain short read
+        return held != logical
+
+    # -- client ops (the PrimaryLogPG::do_op role) ----------------------
+    def _handle_client_op(self, conn: Connection, msg: OSDOp) -> None:
+        try:
+            reply = self._execute_client_op(msg)
+        except Exception as e:  # never kill the dispatch loop
+            reply = OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio", data=str(e).encode()
+            )
+        conn.send(reply)
+
+    def _execute_client_op(self, msg: OSDOp) -> OSDOpReply:
+        epoch = self.osdmap.epoch
+        if msg.pool not in self.osdmap.pools:
+            return OSDOpReply(msg.tid, epoch, error="enoent")
+        acting = self.osdmap.object_to_acting(msg.pool, msg.oid)
+        primary = next((o for o in acting if o != SHARD_NONE), SHARD_NONE)
+        if primary != self.osd_id:
+            return OSDOpReply(msg.tid, epoch, error="eagain")
+        pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
+        with self._op_lock:
+            pg = self._get_pg(msg.pool, pgid)
+            if msg.op == "write":
+                return self._op_write(pg, msg)
+            if msg.op == "read":
+                return self._op_read(pg, msg)
+            if msg.op == "stat":
+                size = self._object_size(pg, msg.oid)
+                if not size and not self.store.exists(msg.oid):
+                    return OSDOpReply(msg.tid, epoch, error="enoent")
+                return OSDOpReply(msg.tid, epoch, size=size)
+            if msg.op == "remove":
+                return self._op_remove(pg, msg)
+            return OSDOpReply(msg.tid, epoch, error="eio",
+                              data=f"bad op {msg.op!r}".encode())
+
+    def _op_write(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        self._object_size(pg, msg.oid)  # prime from attrs on takeover
+        done: list = []
+        pg.rmw.submit(
+            msg.oid, msg.offset, msg.data, on_commit=lambda op: done.append(op)
+        )
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        op = done[0]
+        if op.error is not None:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=str(op.error).encode(),
+            )
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch, size=pg.rmw.object_size(msg.oid)
+        )
+
+    def _op_read(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        size = self._object_size(pg, msg.oid)
+        if not size and not self.store.exists(msg.oid):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        length = msg.length if msg.length else max(size - msg.offset, 0)
+        done: list = []
+        pg.reads.submit(
+            msg.oid, msg.offset, length, on_complete=lambda op: done.append(op)
+        )
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        op = done[0]
+        if op.error is not None:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=str(op.error).encode(),
+            )
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch, size=size, data=op.data
+        )
+
+    def _op_remove(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        if not self._object_size(pg, msg.oid) and not self.store.exists(
+            msg.oid
+        ):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        done: list = []
+        pg.rmw.submit_remove(msg.oid, on_commit=lambda op: done.append(op))
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        op = done[0]
+        if op.error is not None:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=str(op.error).encode(),
+            )
+        return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    # -- failure detection ----------------------------------------------
+    def report_down_peers(self) -> None:
+        """Forward locally observed peer deaths to the monitor (the
+        OSD→mon failure-report channel; OSDMonitor quorum-counts them)."""
+        for osd in sorted(self.peers.down_shards):
+            if self.osdmap.is_up(osd):
+                self.monitor.report_failure(self.osd_id, osd)
+
+    def __repr__(self) -> str:
+        return f"OSDDaemon(osd.{self.osd_id}, e{self.osdmap.epoch})"

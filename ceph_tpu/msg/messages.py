@@ -28,6 +28,8 @@ MSG_EC_SUB_READ = 110         # MOSDECSubOpRead
 MSG_EC_SUB_READ_REPLY = 111   # MOSDECSubOpReadReply
 MSG_PING = 112                # MOSDPing analog (heartbeats)
 MSG_PONG = 113
+MSG_OSD_OP = 114              # MOSDOp (client op to the primary)
+MSG_OSD_OP_REPLY = 115        # MOSDOpReply
 
 VERSION = 1
 
@@ -96,6 +98,10 @@ class ECSubRead:
     oid: str
     extents: list[tuple[int, int]]  # (start, end) pairs
     subchunks: list[tuple[int, int]] | None = None
+    #: logical EC shard index the caller believes this store holds;
+    #: the server cross-checks it against the stored SI attr so a
+    #: CRUSH remap can't serve misplaced bytes (None = don't check).
+    logical: int | None = None
 
     def encode(self) -> list[bytes]:
         return [
@@ -107,6 +113,7 @@ class ECSubRead:
                     "oid": self.oid,
                     "extents": self.extents,
                     "subchunks": self.subchunks,
+                    "logical": self.logical,
                 },
             )
         ]
@@ -121,6 +128,7 @@ class ECSubRead:
             h["oid"],
             [tuple(e) for e in h["extents"]],
             [tuple(s) for s in sub] if sub is not None else None,
+            h.get("logical"),
         )
 
 
@@ -196,6 +204,81 @@ class Pong:
         return cls(h["tid"], h["shard"])
 
 
+@dataclass
+class OSDOp:
+    """Client op to the object's primary OSD (MOSDOp,
+    src/messages/MOSDOp.h). ``epoch`` is the client's map epoch — a
+    primary that disagrees about who owns the object answers
+    ``eagain`` + its epoch and the client re-targets (the
+    resend-on-map-change contract, osdc/Objecter.cc:2127)."""
+
+    tid: int
+    epoch: int
+    pool: str
+    oid: str
+    op: str  # "write" | "read" | "stat" | "remove"
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "osd_op",
+                {
+                    "tid": self.tid,
+                    "epoch": self.epoch,
+                    "pool": self.pool,
+                    "oid": self.oid,
+                    "op": self.op,
+                    "offset": self.offset,
+                    "length": self.length,
+                },
+            ),
+            self.data,
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "OSDOp":
+        h = _parse(segments[0], "osd_op")
+        return cls(
+            h["tid"], h["epoch"], h["pool"], h["oid"], h["op"],
+            h["offset"], h["length"], segments[1],
+        )
+
+
+@dataclass
+class OSDOpReply:
+    """MOSDOpReply: result + data, or a retryable/terminal error.
+    ``error`` ∈ {"", "eagain", "enoent", "eio"}; eagain carries the
+    primary's (newer) epoch so the client refreshes before resending."""
+
+    tid: int
+    epoch: int
+    error: str = ""
+    size: int = 0
+    data: bytes = b""
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "osd_op_reply",
+                {
+                    "tid": self.tid,
+                    "epoch": self.epoch,
+                    "error": self.error,
+                    "size": self.size,
+                },
+            ),
+            self.data,
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "OSDOpReply":
+        h = _parse(segments[0], "osd_op_reply")
+        return cls(h["tid"], h["epoch"], h["error"], h["size"], segments[1])
+
+
 _DECODERS = {
     MSG_EC_SUB_WRITE: ECSubWrite.decode,
     MSG_EC_SUB_WRITE_REPLY: ECSubWriteReply.decode,
@@ -203,6 +286,8 @@ _DECODERS = {
     MSG_EC_SUB_READ_REPLY: ECSubReadReply.decode,
     MSG_PING: Ping.decode,
     MSG_PONG: Pong.decode,
+    MSG_OSD_OP: OSDOp.decode,
+    MSG_OSD_OP_REPLY: OSDOpReply.decode,
 }
 
 _TYPE_OF = {
@@ -212,6 +297,8 @@ _TYPE_OF = {
     ECSubReadReply: MSG_EC_SUB_READ_REPLY,
     Ping: MSG_PING,
     Pong: MSG_PONG,
+    OSDOp: MSG_OSD_OP,
+    OSDOpReply: MSG_OSD_OP_REPLY,
 }
 
 

@@ -239,6 +239,7 @@ class NetShardBackend:
         oid: str,
         extents,
         cb: Callable[[int, object], None],
+        logical: int | None = None,
     ) -> None:
         from ceph_tpu.pipeline.read import ShardReadError
 
@@ -253,15 +254,20 @@ class NetShardBackend:
                 cb(shard, dict(zip(reply.offsets, reply.buffers)))
 
         self._register(tid, shard, oid, on_reply, is_read=True)
-        msg = ECSubRead(tid, shard, oid, [(s, e) for s, e in extents])
+        msg = ECSubRead(
+            tid, shard, oid, [(s, e) for s, e in extents], logical=logical
+        )
         if not self._send(shard, msg, tid):
             self._inbox.put(lambda: cb(shard, ShardReadError(shard, oid)))
 
-    def read_shard(self, shard: int, oid: str, extents) -> dict[int, bytes]:
+    def read_shard(
+        self, shard: int, oid: str, extents, logical: int | None = None
+    ) -> dict[int, bytes]:
         """Synchronous single-shard read (drains inline)."""
         out: dict[str, object] = {}
         self.read_shard_async(
-            shard, oid, extents, lambda s, r: out.update(r=r)
+            shard, oid, extents, lambda s, r: out.update(r=r),
+            logical=logical,
         )
         self.drain_until(lambda: "r" in out, timeout=self.timeout + 5)
         result = out["r"]

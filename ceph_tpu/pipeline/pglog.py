@@ -26,11 +26,14 @@ from .extents import ExtentSet
 
 @dataclass
 class LogEntry:
-    """One client write (the pg_log_entry_t analog)."""
+    """One client op (the pg_log_entry_t analog). ``delete`` entries
+    (pg_log_entry_t::DELETE) touch every shard and supersede earlier
+    writes of the oid for recovery purposes."""
 
     tid: int
     oid: str
     shard_extents: dict[int, ExtentSet] = field(default_factory=dict)
+    delete: bool = False
 
 
 class PGLog:
@@ -50,6 +53,13 @@ class PGLog:
         self.entries.append(
             LogEntry(tid, oid, {s: es.copy() for s, es in shard_extents.items()})
         )
+
+    def append_delete(self, tid: int, oid: str) -> None:
+        """Record a whole-object remove: a shard that misses it would
+        otherwise RESURRECT the object during delta recovery."""
+        if self.entries and tid <= self.entries[-1].tid:
+            raise ValueError(f"non-monotonic log append: tid {tid}")
+        self.entries.append(LogEntry(tid, oid, {}, delete=True))
 
     def ack(self, shard: int, tid: int) -> None:
         """A shard durably applied its sub-write for ``tid``."""
@@ -84,11 +94,16 @@ class PGLog:
     def dirty_extents(self, shard: int) -> dict[str, ExtentSet]:
         """Per-object extents this shard is missing: everything written
         past its contiguous frontier (the missing-set computation of
-        PGLog::merge_log, as extents instead of whole objects)."""
+        PGLog::merge_log, as extents instead of whole objects). A
+        delete entry resets the oid — only writes AFTER the last
+        delete count (the object was recreated)."""
         frontier = self._completed[shard]
         out: dict[str, ExtentSet] = {}
         for e in self.entries:
             if e.tid <= frontier:
+                continue
+            if e.delete:
+                out.pop(e.oid, None)
                 continue
             es = e.shard_extents.get(shard)
             if not es:
@@ -96,6 +111,20 @@ class PGLog:
             acc = out.setdefault(e.oid, ExtentSet())
             for start, end in es:
                 acc.insert(start, end - start)
+        return out
+
+    def dirty_deletes(self, shard: int) -> set[str]:
+        """Oids whose FINAL state past the shard's frontier is
+        'removed' — recovery must apply the delete, not rebuild data."""
+        frontier = self._completed[shard]
+        out: set[str] = set()
+        for e in self.entries:
+            if e.tid <= frontier:
+                continue
+            if e.delete:
+                out.add(e.oid)
+            elif e.shard_extents.get(shard):
+                out.discard(e.oid)  # recreated after the delete
         return out
 
     def mark_recovered(self, shard: int, up_to: int | None = None) -> None:

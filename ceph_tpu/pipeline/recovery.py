@@ -33,7 +33,7 @@ from .read import (
     get_min_avail_to_read_shards,
     reconstruct_shards,
 )
-from .rmw import HINFO_KEY
+from .rmw import HINFO_KEY, OI_KEY, SI_KEY
 from .shard_map import ShardExtentMap
 from .stripe import StripeInfo
 
@@ -265,6 +265,11 @@ class RecoveryBackend:
                 op.recovered_bytes += len(buf)
             if hinfo_bytes is not None:
                 txn.setattr(op.oid, HINFO_KEY, hinfo_bytes)
+            # identity attrs, as the original write txn carried them:
+            # size for new-primary takeover, shard index for the
+            # misplacement guard
+            txn.setattr(op.oid, OI_KEY, str(size).encode())
+            txn.setattr(op.oid, SI_KEY, str(shard).encode())
             self.backend.submit_shard_txn(
                 shard,
                 txn,
@@ -281,6 +286,19 @@ class RecoveryBackend:
         missing-set semantics). Marks the shard recovered on success."""
         head = pglog.head()
         ops: dict[str, RecoveryOp] = {}
+        # deletes first: a shard that missed a remove still holds the
+        # object's stale bytes — resurrection unless replayed
+        drain = getattr(self.backend, "drain_until", None)
+        pending: set[str] = set()
+        for oid in sorted(pglog.dirty_deletes(shard)):
+            pending.add(oid)
+            self.backend.submit_shard_txn(
+                shard,
+                Transaction().touch(oid).remove(oid),
+                lambda o=oid: pending.discard(o),
+            )
+        if pending and drain is not None:
+            drain(lambda: not pending)
         for oid, extents in sorted(pglog.dirty_extents(shard).items()):
             ops[oid] = self.recover_object(
                 oid, {shard}, extents={shard: extents}

@@ -44,6 +44,15 @@ from .shard_map import ShardExtentMap
 from .stripe import StripeInfo
 
 HINFO_KEY = "hinfo_key"  # ECUtil.cc:1179
+#: object-info attr: the rados object size travels with every shard
+#: txn (the object_info_t "_" attr role) so a NEW primary can recover
+#: sizes after failover instead of trusting in-memory state.
+OI_KEY = "oi"
+#: shard-index attr: which logical EC shard these bytes are. Read
+#: paths compare it against the position they are asking for, so a
+#: CRUSH remap can never silently serve shard j's bytes as shard i
+#: (misplaced data reads as a clean error until backfill moves it).
+SI_KEY = "si"
 
 
 @dataclass
@@ -99,10 +108,15 @@ def plan_write(
         s: es for s, es in touched.items() if sinfo.is_data_shard(s)
     }
 
-    # Full-stripe read set: chunk-aligned hull minus what we overwrite.
+    # Full-stripe read set: the PAGE window of the write minus what we
+    # overwrite. The window must be the page-aligned to_write hull, not
+    # the chunk hull: the encode pads to pages, so a parity page covers
+    # every stripe inside it — with chunk_size < page that reaches
+    # stripes the chunk hull misses, and encoding them without their
+    # old data would zero them into parity (silent corruption).
     full_read: dict[int, ExtentSet] = {}
-    lo = sinfo.ro_offset_to_prev_chunk_offset(ro_offset)
-    hi = sinfo.ro_offset_to_next_chunk_offset(ro_offset + length)
+    lo = min(es.range_start() for es in to_write.values())
+    hi = max(es.range_end() for es in to_write.values())
     for raw in range(sinfo.k):
         shard = sinfo.get_shard(raw)
         hull = ExtentSet([(lo, hi)])
@@ -284,6 +298,9 @@ class RMWPipeline:
         self._inflight: "OrderedDict[int, ClientOp]" = OrderedDict()
         self._object_sizes: dict[str, int] = {}
         self._hinfo: dict[str, HashInfo] = {}
+        #: oid -> backend-read failure awaiting its op (degraded RMW
+        #: read failed; the op aborts in _cache_ready, in order)
+        self._read_errors: dict[str, Exception] = {}
         from ceph_tpu.utils import PerfCountersBuilder, perf_collection
 
         self.perf = (
@@ -311,6 +328,13 @@ class RMWPipeline:
         self._inflight[op.tid] = op
         self.perf.inc("write_ops")
         self.perf.inc("write_bytes", len(data))
+
+        if not data:
+            # Zero-length write: a no-op that still commits in order
+            # (plan_write has no extents to plan over).
+            op.committed = True
+            self._check_commit_order()
+            return op.tid
 
         from .inject import ec_inject
 
@@ -348,23 +372,116 @@ class RMWPipeline:
             self.cache.execute([op.cache_op])
         return op.tid
 
+    def submit_remove(
+        self,
+        oid: str,
+        on_commit: Callable[[ClientOp], None] | None = None,
+    ) -> int:
+        """Whole-object remove, ordered through the same per-object
+        cache FIFO as writes (a remove racing an in-flight write must
+        apply after it) and journaled in the pg log so a down shard
+        cannot resurrect the object on recovery."""
+        op = ClientOp(self._next_tid, oid, 0, b"", on_commit)
+        op.t_submit = time.perf_counter()
+        self._next_tid += 1
+        self._inflight[op.tid] = op
+
+        def dispatch(cop, _op=op) -> None:
+            try:
+                live = set(self.backend.avail_shards())
+                if self.pglog is not None:
+                    self.pglog.append_delete(_op.tid, oid)
+                _op.pending_shards = set(live)
+                _op.written = ShardExtentMap(self.sinfo)
+                self._object_sizes.pop(oid, None)
+                self._hinfo.pop(oid, None)
+                for shard in sorted(live):
+                    # touch+remove: no-op on shards that never got the
+                    # object (a hole at write time)
+                    self.backend.submit_shard_txn(
+                        shard,
+                        Transaction().touch(oid).remove(oid),
+                        lambda s=shard, o=_op: self._shard_ack(o, s),
+                    )
+            except Exception as e:
+                self._abort_op(_op, e)
+
+        op.cache_op = self.cache.prepare(oid, {}, {}, 0, dispatch)
+        self.cache.execute([op.cache_op])
+        return op.tid
+
     def object_size(self, oid: str) -> int:
         return self._object_sizes.get(oid, 0)
+
+    def prime_object(
+        self, oid: str, size: int, hinfo: HashInfo | None = None
+    ) -> None:
+        """Seed per-object state recovered from stored attrs (OI_KEY /
+        HINFO_KEY) — the new-primary takeover path: a freshly elected
+        primary must not assume unknown objects are empty."""
+        self._object_sizes[oid] = size
+        if hinfo is not None:
+            self._hinfo[oid] = hinfo
 
     def hinfo(self, oid: str) -> HashInfo | None:
         return self._hinfo.get(oid)
 
     # -- pipeline stages ------------------------------------------------
     def _backend_read(self, oid: str, want: dict[int, ExtentSet]) -> None:
+        """Fetch old data for an RMW. When a wanted shard is down its
+        old bytes are reconstructed from a MINIMAL survivor set — the
+        same planner + decode the degraded client read uses
+        (get_min_avail_to_read_shards / objects_read_and_reconstruct,
+        osd/ECBackend.cc:1725). Failures never propagate: the error is
+        parked for ``_cache_ready`` to abort the op in order."""
+        from .read import get_min_avail_to_read_shards
+
         smap = ShardExtentMap(self.sinfo)
-        for shard, es in want.items():
-            for start, buf in self.backend.read_shard(shard, oid, es).items():
-                smap.insert(shard, start, buf)
+        try:
+            avail = set(self.backend.avail_shards())
+            holes = {s for s in want if s not in avail}
+            reads, need_decode = get_min_avail_to_read_shards(
+                self.sinfo, self.codec, want, avail
+            )
+            for sr in reads.values():
+                for start, buf in self.backend.read_shard(
+                    sr.shard, oid, sr.extents
+                ).items():
+                    smap.insert(sr.shard, start, buf)
+            if need_decode:
+                smap.decode(
+                    self.codec, holes, self._object_sizes.get(oid, 0)
+                )
+        except Exception as e:
+            self._read_errors[oid] = e
         self.cache.read_done(oid, smap)
+
+    def _abort_op(self, op: ClientOp, err: Exception) -> None:
+        """Fail an op cleanly AFTER it entered the cache: release the
+        cache op (else its pinned lines wedge every later write to the
+        object) and complete in order with the error."""
+        op.error = err
+        op.committed = True
+        self.perf.inc("aborts")
+        if op.cache_op is not None and op.written is None:
+            self.cache.write_done(op.cache_op, ShardExtentMap(self.sinfo))
+        self._check_commit_order()
 
     def _cache_ready(self, op: ClientOp) -> None:
         """Old data present — encode and generate per-shard transactions
-        (the cache_ready → generate_transactions hop, ECCommon.cc:688)."""
+        (the cache_ready → generate_transactions hop, ECCommon.cc:688).
+        Any failure in here (degraded read couldn't reconstruct, codec
+        error) aborts the op in order instead of wedging the pipeline."""
+        err = self._read_errors.pop(op.oid, None)
+        if err is not None:
+            self._abort_op(op, err)
+            return
+        try:
+            self._cache_ready_inner(op)
+        except Exception as e:
+            self._abort_op(op, e)
+
+    def _cache_ready_inner(self, op: ClientOp) -> None:
         sinfo = self.sinfo
         old_map = op.cache_op.result
         old_size = self._object_sizes.get(op.oid, 0)
@@ -431,7 +548,18 @@ class RMWPipeline:
         the refreshed hinfo attr (ECTransaction.cc:497,902)."""
         sinfo = self.sinfo
         hinfo_bytes = self._get_hinfo(op.oid).to_bytes()
-        op.pending_shards = set(range(sinfo.k + sinfo.m))
+        # Dispatch to LIVE shards only: an acting-set hole (down OSD)
+        # does not block the write — its extents are journaled in the
+        # pg log for delta recovery when the shard returns (the
+        # reference commits on the acting set, not k+m). Floor: k live
+        # shards (min_size), else the object could become unreadable.
+        live = set(self.backend.avail_shards())
+        if len(live) < sinfo.k:
+            # raises into _cache_ready's wrapper -> clean in-order abort
+            raise IOError(
+                f"only {len(live)} shards available, need {sinfo.k}"
+            )
+        op.pending_shards = set(live)
         written = ShardExtentMap(sinfo)
         op.written = written
         txns: list[tuple[int, Transaction]] = []
@@ -447,6 +575,8 @@ class RMWPipeline:
                 txn.write(op.oid, start, buf)
                 written.insert(shard, start, np.frombuffer(buf, np.uint8))
             txn.setattr(op.oid, HINFO_KEY, hinfo_bytes)
+            txn.setattr(op.oid, OI_KEY, str(new_size).encode())
+            txn.setattr(op.oid, SI_KEY, str(shard).encode())
             txns.append((shard, txn))
         if self.pglog is not None:
             self.pglog.append(
@@ -457,6 +587,8 @@ class RMWPipeline:
         # build every txn before the first dispatch: a synchronous ack
         # (local stores) must see the complete written map
         for shard, txn in txns:
+            if shard not in live:
+                continue  # hole: journaled above, recovered later
             self.backend.submit_shard_txn(
                 shard, txn, lambda s=shard, o=op: self._shard_ack(o, s)
             )

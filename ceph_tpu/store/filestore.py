@@ -60,13 +60,18 @@ class FileStore:
         with open(self.journal_path, "rb") as f:
             raw = f.read()
         pos = 0
+        touched: set[str] = set()
         while pos + _JHDR.size <= len(raw):
             length, crc = _JHDR.unpack_from(raw, pos)
             payload = raw[pos + _JHDR.size : pos + _JHDR.size + length]
             if len(payload) < length or _crc(0xFFFFFFFF, payload) != crc:
                 break  # torn tail write: discard from here
-            self._apply(Transaction.from_bytes(payload), strict=False)
+            txn = Transaction.from_bytes(payload)
+            self._apply(txn, strict=False)
+            touched.update(op.oid for op in txn.ops)
             pos += _JHDR.size + length
+        # replayed state must be durable before the journal goes away
+        self._fsync_objects(touched)
         os.unlink(self.journal_path)
 
     def queue_transactions(
@@ -95,24 +100,26 @@ class FileStore:
             # 3. make the applied state durable BEFORE retiring the
             #    journal — otherwise a power cut after the unlink but
             #    before the page cache drains loses an acked commit.
-            touched = {op.oid for txn in txns for op in txn.ops}
-            for oid in touched:
-                for p in self._paths(oid):
-                    if os.path.exists(p):
-                        fd = os.open(p, os.O_RDONLY)
-                        try:
-                            os.fsync(fd)
-                        finally:
-                            os.close(fd)
-            dfd = os.open(self.objdir, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+            self._fsync_objects({op.oid for txn in txns for op in txn.ops})
             # 4. retire
             os.unlink(self.journal_path)
             self.committed_seq += 1
             return self.committed_seq
+
+    def _fsync_objects(self, oids: "set[str]") -> None:
+        for oid in oids:
+            for p in self._paths(oid):
+                if os.path.exists(p):
+                    fd = os.open(p, os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+        dfd = os.open(self.objdir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def _validate(self, txns: "list[Transaction]") -> None:
         """Dry-run the op list against simulated state so the journal

@@ -1,0 +1,283 @@
+"""OSDMap + Monitor control-plane semantics.
+
+Models the reference contracts: incremental map evolution
+(OSDMap::Incremental), down-vs-out placement behavior
+(pg_to_up_acting_osds holes vs rebalance), profile validation at the
+monitor (OSDMonitor::parse_erasure_code_profile), failure-report
+quorum (check_failure), auto-out, and subscription catch-up.
+"""
+
+import pytest
+
+from ceph_tpu.cluster import (
+    CommandError,
+    Incremental,
+    Monitor,
+    OSDInfo,
+    OSDMap,
+    SHARD_NONE,
+)
+from ceph_tpu.utils import config
+
+
+def mk_monitor(n_osds=8, clock=None):
+    mon = Monitor(**({"clock": clock} if clock else {}))
+    for i in range(n_osds):
+        mon.osd_crush_add(i, weight=1.0, zone=f"z{i % 4}")
+        mon.osd_boot(i, ("127.0.0.1", 7000 + i))
+    return mon
+
+
+def mk_pool(mon, name="ecpool", k=4, m=2, pg_num=16):
+    mon.osd_erasure_code_profile_set(
+        "rs62", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": str(k), "m": str(m)}
+    )
+    mon.osd_pool_create(name, pg_num, "rs62")
+    return mon.osdmap
+
+
+# -- OSDMap value semantics ---------------------------------------------
+
+
+def test_incremental_must_follow_epoch():
+    m = OSDMap()
+    with pytest.raises(ValueError):
+        m.apply(Incremental(epoch=5))
+
+
+def test_map_roundtrips_through_bytes():
+    mon = mk_monitor(6)
+    m = mk_pool(mon)
+    m2 = OSDMap.from_bytes(m.to_bytes())
+    assert m2.epoch == m.epoch
+    assert m2.pools.keys() == m.pools.keys()
+    assert m2.profiles == m.profiles
+    for oid in ("a", "b", "c"):
+        assert m2.object_to_acting("ecpool", oid) == m.object_to_acting(
+            "ecpool", oid
+        )
+
+
+def test_incremental_roundtrips_through_bytes():
+    incr = Incremental(
+        epoch=3,
+        new_osds=(OSDInfo(1, 2.0, "z1", True, True, ("h", 1)),),
+        down=(2,),
+        new_profiles=(("p", (("k", "4"), ("m", "2"))),),
+    )
+    assert Incremental.from_bytes(incr.to_bytes()) == incr
+
+
+def test_acting_set_positions_are_stable_shards():
+    mon = mk_monitor(8)
+    m = mk_pool(mon)
+    acting = m.object_to_acting("ecpool", "obj")
+    assert len(acting) == 6
+    assert len(set(acting)) == 6  # distinct devices
+    # deterministic
+    assert m.object_to_acting("ecpool", "obj") == acting
+
+
+def test_down_makes_holes_not_movement():
+    """Down-but-in: the shard position becomes SHARD_NONE; every other
+    position keeps its device (degraded, no rebalance)."""
+    mon = mk_monitor(8)
+    m = mk_pool(mon)
+    acting = m.object_to_acting("ecpool", "obj")
+    victim = acting[2]
+    m2 = mon.osd_down(victim)
+    after = m2.object_to_acting("ecpool", "obj")
+    assert after[2] == SHARD_NONE
+    assert [a for i, a in enumerate(after) if i != 2] == [
+        a for i, a in enumerate(acting) if i != 2
+    ]
+    assert m2.primary("ecpool", "obj") == after[0]
+
+
+def test_out_remaps_the_hole():
+    """Marking out removes the device from crush input: the hole is
+    refilled by a substitute device (rebalance)."""
+    mon = mk_monitor(8)
+    m = mk_pool(mon)
+    acting = m.object_to_acting("ecpool", "obj")
+    victim = acting[0]
+    mon.osd_down(victim)
+    m2 = mon.osd_out(victim)
+    after = m2.object_to_acting("ecpool", "obj")
+    assert victim not in after
+    assert SHARD_NONE not in after
+    assert len(set(after)) == 6
+
+
+def test_minimal_movement_on_out():
+    """CRUSH property: removing one device only remaps PGs that used
+    it — every other PG's acting set is untouched."""
+    mon = mk_monitor(10)
+    m = mk_pool(mon, pg_num=64)
+    before = {pg: m.pg_to_up_acting("ecpool", pg) for pg in range(64)}
+    victim = before[0][0]
+    mon.osd_down(victim)
+    m2 = mon.osd_out(victim)
+    moved = unmoved = 0
+    for pg in range(64):
+        after = m2.pg_to_up_acting("ecpool", pg)
+        if victim in before[pg]:
+            assert victim not in after
+            moved += 1
+        else:
+            assert after == before[pg]
+            unmoved += 1
+    assert moved > 0 and unmoved > 0
+
+
+def test_reboot_heals_holes():
+    mon = mk_monitor(8)
+    m = mk_pool(mon)
+    acting = m.object_to_acting("ecpool", "obj")
+    victim = acting[1]
+    mon.osd_down(victim)
+    m2 = mon.osd_boot(victim, ("127.0.0.1", 7999))
+    assert m2.object_to_acting("ecpool", "obj") == acting
+    assert m2.get_addr(victim) == ("127.0.0.1", 7999)
+
+
+def test_distinct_zones_pool():
+    mon = mk_monitor(8)  # 4 zones x 2 osds
+    mon.osd_erasure_code_profile_set(
+        "rs22", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": "2", "m": "2"}
+    )
+    mon.osd_pool_create("zpool", 8, "rs22", distinct_zones=True)
+    m = mon.osdmap
+    for pg in range(8):
+        acting = m.pg_to_up_acting("zpool", pg)
+        zones = [m.osds[o].zone for o in acting]
+        assert len(set(zones)) == 4
+
+
+# -- Monitor commands ----------------------------------------------------
+
+
+def test_profile_validation_rejects_garbage():
+    mon = mk_monitor(4)
+    with pytest.raises(CommandError):
+        mon.osd_erasure_code_profile_set("bad", {"plugin": "nope"})
+    with pytest.raises(CommandError):
+        mon.osd_erasure_code_profile_set(
+            "bad2", {"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "0", "m": "2"}
+        )
+    assert "bad" not in mon.osdmap.profiles
+
+
+def test_profile_overwrite_requires_force():
+    mon = mk_monitor(4)
+    prof = {"plugin": "jerasure", "technique": "reed_sol_van",
+            "k": "2", "m": "1"}
+    mon.osd_erasure_code_profile_set("p", prof)
+    # identical re-set is a no-op
+    mon.osd_erasure_code_profile_set("p", dict(prof))
+    changed = dict(prof, m="2")
+    with pytest.raises(CommandError):
+        mon.osd_erasure_code_profile_set("p", changed)
+    mon.osd_erasure_code_profile_set("p", changed, force=True)
+    assert mon.osdmap.profiles["p"]["m"] == "2"
+
+
+def test_pool_create_derives_km_from_codec():
+    mon = mk_monitor(8)
+    m = mk_pool(mon, k=4, m=2)
+    spec = m.pools["ecpool"]
+    assert (spec.k, spec.m, spec.size) == (4, 2, 6)
+    assert spec.plugin == "jerasure"
+
+
+def test_pool_create_default_profile():
+    mon = mk_monitor(6)
+    mon.osd_pool_create("dflt", 8)  # erasure_code_default_profile k=2 m=2
+    spec = mon.osdmap.pools["dflt"]
+    assert (spec.k, spec.m) == (2, 2)
+
+
+def test_pool_duplicate_and_rm():
+    mon = mk_monitor(6)
+    mk_pool(mon)
+    with pytest.raises(CommandError):
+        mon.osd_pool_create("ecpool", 8, "rs62")
+    mon.osd_pool_rm("ecpool")
+    assert "ecpool" not in mon.osdmap.pools
+    with pytest.raises(CommandError):
+        mon.osd_pool_rm("ecpool")
+
+
+# -- failure reports & auto-out ------------------------------------------
+
+
+def test_failure_requires_distinct_reporters():
+    mon = mk_monitor(6)
+    assert config.get("mon_osd_min_down_reporters") == 2
+    assert mon.report_failure(1, 0) is None  # one reporter: not enough
+    assert mon.report_failure(1, 0) is None  # same reporter again
+    assert mon.osdmap.is_up(0)
+    m = mon.report_failure(2, 0)  # second distinct reporter
+    assert m is not None and not m.is_up(0)
+    # further reports about a down osd are ignored
+    assert mon.report_failure(3, 0) is None
+    # self-reports never count
+    assert mon.report_failure(5, 5) is None
+
+
+def test_boot_clears_pending_reports():
+    mon = mk_monitor(6)
+    mon.report_failure(1, 0)
+    mon.osd_boot(0, ("127.0.0.1", 7000))
+    assert mon.report_failure(2, 0) is None  # evidence was reset
+    assert mon.osdmap.is_up(0)
+
+
+def test_auto_out_after_interval():
+    t = [0.0]
+    mon = mk_monitor(8, clock=lambda: t[0])
+    mk_pool(mon)
+    mon.osd_down(3)
+    assert mon.tick() is None  # too soon
+    t[0] += config.get("mon_osd_down_out_interval") + 1
+    m = mon.tick()
+    assert m is not None and not m.osds[3].in_
+    assert mon.tick() is None  # idempotent
+
+
+# -- subscriptions & catch-up --------------------------------------------
+
+
+def test_subscribe_sees_every_epoch():
+    mon = mk_monitor(4)
+    seen = []
+    mon.subscribe(lambda m: seen.append(m.epoch))
+    e0 = mon.osdmap.epoch
+    mk_pool(mon)
+    assert seen[0] == e0
+    assert seen[-1] == mon.osdmap.epoch
+    assert seen[1:] == list(range(e0 + 1, mon.osdmap.epoch + 1))
+
+
+def test_incremental_catch_up_replays_to_current():
+    mon = mk_monitor(6)
+    snapshot = mon.osdmap
+    mk_pool(mon)
+    mon.osd_down(2)
+    incrs = mon.get_incrementals(snapshot.epoch)
+    m = snapshot
+    for incr in incrs:
+        m = m.apply(incr)
+    assert m.epoch == mon.osdmap.epoch
+    assert m.to_bytes() == mon.osdmap.to_bytes()
+
+
+def test_trimmed_history_forces_full_map():
+    mon = mk_monitor(6)
+    mk_pool(mon)
+    mon.trim_history(keep=1)
+    assert mon.get_incrementals(0) is None
+    assert mon.get_incrementals(mon.osdmap.epoch - 1) is not None

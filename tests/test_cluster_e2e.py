@@ -1,0 +1,219 @@
+"""End-to-end mini-cluster: monitor + OSD daemons + librados-style
+client over real sockets — the standalone-cluster test tier
+(qa/standalone/erasure-code/test-erasure-code.sh: boot daemons, create
+EC pool, put/get, kill OSDs, verify service continues).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+
+
+@pytest.fixture
+def cluster():
+    """mon + 6 OSDs + EC(3,2) pool + connected client."""
+    mon = Monitor()
+    daemons = []
+    for i in range(6):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+    for i in range(6):
+        d = OSDDaemon(i, mon, chunk_size=1024)
+        d.start()
+        daemons.append(d)
+    mon.osd_erasure_code_profile_set(
+        "rs32", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": "3", "m": "2"}
+    )
+    mon.osd_pool_create("ecpool", 8, "rs32")
+    client = RadosClient(mon, backoff=0.01)
+    yield mon, daemons, client
+    client.shutdown()
+    for d in daemons:
+        d.stop()
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8
+    ).tobytes()
+
+
+def test_write_read_roundtrip_over_wire(cluster):
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = payload(10_000)
+    size = io.write("obj", data)
+    assert size == 10_000
+    assert io.read("obj") == data
+    assert io.stat("obj") == 10_000
+
+
+def test_partial_read_and_overwrite(cluster):
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = bytearray(payload(8_000))
+    io.write("obj", bytes(data))
+    patch = payload(500, seed=1)
+    io.write("obj", patch, offset=2_000)
+    data[2_000:2_500] = patch
+    assert io.read("obj", offset=1_900, length=800) == bytes(
+        data[1_900:2_700]
+    )
+    assert io.read("obj") == bytes(data)
+
+
+def test_many_objects_spread_over_primaries(cluster):
+    """Different objects hash to different PGs/primaries; every one
+    round-trips (multi-primary routing, not a single-server accident)."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    blobs = {}
+    for i in range(12):
+        blobs[f"o{i}"] = payload(1_500 + 37 * i, seed=i)
+        io.write(f"o{i}", blobs[f"o{i}"])
+    primaries = {
+        mon.osdmap.primary("ecpool", oid) for oid in blobs
+    }
+    assert len(primaries) > 1
+    for oid, blob in blobs.items():
+        assert io.read(oid) == blob
+
+
+def test_missing_object_and_pool_errors(cluster):
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    with pytest.raises(FileNotFoundError):
+        io.read("ghost")
+    with pytest.raises(FileNotFoundError):
+        io.stat("ghost")
+    with pytest.raises(FileNotFoundError):
+        io.remove("ghost")
+    with pytest.raises(FileNotFoundError):
+        client.open_ioctx("nopool")
+
+
+def test_remove_roundtrip(cluster):
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("obj", payload(3_000))
+    io.remove("obj")
+    with pytest.raises(FileNotFoundError):
+        io.read("obj")
+    # recreate after remove
+    io.write("obj", b"fresh")
+    assert io.read("obj") == b"fresh"
+
+
+def test_wrong_primary_resends_after_map_change(cluster):
+    """Kill an object's primary: the monitor marks it down, the next
+    live shard-holder serves, and the client's retry loop lands there
+    (Objecter resend-on-map-change)."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = payload(6_000)
+    io.write("obj", data)
+    primary = mon.osdmap.primary("ecpool", "obj")
+    daemons[primary].stop()
+    mon.osd_down(primary)  # failure detection, collapsed to a command
+    new_primary = mon.osdmap.primary("ecpool", "obj")
+    assert new_primary != primary
+    before = client.objecter.resends
+    got = io.read("obj")  # degraded read through the new primary
+    assert got == data
+    assert client.objecter.resends >= before
+
+
+def test_degraded_write_then_heal_read(cluster):
+    """Writes succeed with one OSD down (k+m-1 live shards); reads see
+    the full object."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    victim = mon.osdmap.object_to_acting("ecpool", "obj")[-1]  # a non-primary
+    daemons[victim].stop()
+    mon.osd_down(victim)
+    data = payload(5_000)
+    io.write("obj", data)
+    assert io.read("obj") == data
+
+
+def test_failover_primary_recovers_object_state(cluster):
+    """After primary failover, the NEW primary recovers object size +
+    crc state from stored attrs (OI/hinfo) and serves overwrites
+    correctly — the object_info_t takeover path."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = bytearray(payload(7_000))
+    io.write("obj", bytes(data))
+    primary = mon.osdmap.primary("ecpool", "obj")
+    daemons[primary].stop()
+    mon.osd_down(primary)
+    # overwrite through the new primary: needs the recovered size
+    patch = payload(400, seed=2)
+    io.write("obj", patch, offset=6_800)  # extends to 7_200
+    data[6_800:7_000] = patch[:200]
+    data.extend(patch[200:])
+    assert io.stat("obj") == 7_200
+    assert io.read("obj") == bytes(data)
+
+
+def test_zero_length_write_is_ordered_noop(cluster):
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("obj", b"")
+    io.write("obj", b"abc")
+    io.write("obj", b"", offset=100)
+    assert io.read("obj") == b"abc"
+
+
+def test_returning_member_catches_up_from_log(cluster):
+    """Write while a member is down, bring it back: the primary replays
+    the op log onto it (delta recovery) and a read served FROM that
+    member's shard returns the new bytes — not its stale ones."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = payload(9_000)
+    io.write("obj", data)
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    victim = acting[1]  # a non-primary data shard
+    mon.osd_down(victim)  # down, NOT stopped: store survives, stale
+    data2 = payload(9_000, seed=3)
+    io.write("obj", data2)  # victim misses this entirely
+    mon.osd_boot(victim, daemons[victim].addr)  # returns; log recovery
+    # force reads to use the returned member: take down a different
+    # data shard so decode MUST include victim's shard
+    other = next(
+        o for o in mon.osdmap.object_to_acting("ecpool", "obj")
+        if o not in (victim, acting[0]) and o != -1
+    )
+    daemons[other].stop()
+    mon.osd_down(other)
+    assert io.read("obj") == data2
+
+
+def test_remove_succeeds_with_write_time_hole(cluster):
+    """An object written while one member was down can still be
+    removed after that member returns (no ENOENT from the shard that
+    never got it)."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    victim = mon.osdmap.object_to_acting("ecpool", "obj")[2]
+    mon.osd_down(victim)
+    io.write("obj", payload(2_000))
+    mon.osd_boot(victim, daemons[victim].addr)
+    io.remove("obj")
+    with pytest.raises(FileNotFoundError):
+        io.stat("obj")
+
+
+def test_peer_failure_reports_reach_monitor(cluster):
+    """OSDs that observe a dead peer report it; the monitor marks it
+    down once two distinct reporters agree."""
+    mon, daemons, client = cluster
+    victim = 5
+    daemons[victim].stop()
+    # two daemons observe the death (heartbeat seam, forced here)
+    for reporter in (0, 1):
+        daemons[reporter].peers.down_shards.add(victim)
+        daemons[reporter].report_down_peers()
+    assert not mon.osdmap.is_up(victim)

@@ -1,0 +1,125 @@
+"""Paxos quorum semantics (the mon/Paxos.cc + Elector.cc contracts):
+majority commit, minority stall, competing-proposer convergence,
+leader-failover durability, and Monitor integration."""
+
+import pytest
+
+from ceph_tpu.cluster import Monitor
+from ceph_tpu.cluster.paxos import MonCluster, QuorumLost
+
+
+def test_majority_commit_learns_everywhere():
+    mc = MonCluster(3)
+    slot = mc.commit(b"epoch1")
+    assert slot == 0
+    assert mc.commit(b"epoch2") == 1
+    for node in mc.nodes:
+        assert node.committed_values() == [b"epoch1", b"epoch2"]
+
+
+def test_minority_partition_cannot_commit():
+    mc = MonCluster(3)
+    mc.commit(b"before")
+    mc.transport.partition((0,), (1, 2))  # rank 0 isolated
+    with pytest.raises(QuorumLost):
+        mc.nodes[0].propose(1, b"doomed")
+    # the majority side continues
+    leader = mc.elect(from_rank=1)
+    assert leader.rank == 1
+    mc.commit(b"after", leader=leader)
+    assert mc.nodes[1].committed_values() == [b"before", b"after"]
+    # the isolated node never saw slot 1
+    assert mc.nodes[0].last_committed() == 0
+
+
+def test_healed_minority_catches_up_via_sync():
+    mc = MonCluster(3)
+    mc.commit(b"a")
+    mc.transport.partition((0,), (1, 2))
+    mc.commit(b"b", leader=mc.elect(from_rank=1))
+    mc.transport.heal()
+    # next election re-syncs; commit propagates the log to rank 0
+    mc.commit(b"c")
+    for node in mc.nodes:
+        assert node.committed_values() == [b"a", b"b", b"c"]
+
+
+def test_competing_proposers_converge_to_one_value():
+    """Two proposers fight for slot 0; exactly one value is decided
+    and both learn the same one (the accepted-value adoption rule)."""
+    mc = MonCluster(3)
+    v1 = mc.nodes[0].propose(0, b"from0")
+    v2 = mc.nodes[2].propose(0, b"from2")
+    assert v1 == v2 == b"from0"  # first decision sticks
+    for node in mc.nodes:
+        assert node.slots[0].committed == b"from0"
+
+
+def test_accepted_but_unlearned_value_survives_leader_death():
+    """A value accepted at a majority but never learned (leader died
+    mid-commit) MUST be recovered by the next leader's sync."""
+    mc = MonCluster(3)
+    n0 = mc.nodes[0]
+    pn = n0._next_pn()
+    # phase 1+2 by hand at a majority (0 and 1), no learn anywhere
+    assert n0.on_prepare(0, pn)[0]
+    assert mc.nodes[1].on_prepare(0, pn)[0]
+    assert n0.on_accept(0, pn, b"ghost")
+    assert mc.nodes[1].on_accept(0, pn, b"ghost")
+    # leader 0 dies
+    mc.transport.partition((0,), (1, 2))
+    leader = mc.elect(from_rank=1)
+    assert leader.rank == 1
+    # sync must have committed the ghost value, not lost it
+    assert mc.nodes[1].slots[0].committed == b"ghost"
+    assert mc.commit(b"next", leader=leader) == 1
+
+
+def test_five_node_quorum_tolerates_two_failures():
+    mc = MonCluster(5)
+    mc.commit(b"x")
+    mc.transport.partition((3, 4), (0, 1, 2))
+    leader = mc.elect()
+    assert leader.rank == 0
+    mc.commit(b"y", leader=leader)
+    assert mc.nodes[2].committed_values() == [b"x", b"y"]
+    mc.transport.partition((0,), (1, 2))  # three groups: quorum gone
+    with pytest.raises(QuorumLost):
+        mc.elect(from_rank=1)
+
+
+def test_monitor_over_paxos_replicates_incrementals():
+    """Monitor(commit_fn=quorum) — every map epoch lands in the
+    replicated log, and a rebuilt monitor replays to the same map."""
+    from ceph_tpu.cluster import Incremental, OSDMap
+
+    mc = MonCluster(3)
+    mon = Monitor(commit_fn=lambda incr: mc.commit(incr.to_bytes()))
+    for i in range(4):
+        mon.osd_crush_add(i, zone=f"z{i}")
+        mon.osd_boot(i, ("127.0.0.1", 7000 + i))
+    mon.osd_erasure_code_profile_set(
+        "p", {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+    )
+    mon.osd_pool_create("pool", 8, "p")
+    # rebuild state purely from any replica's log
+    m = OSDMap()
+    for blob in mc.nodes[2].committed_values():
+        m = m.apply(Incremental.from_bytes(blob))
+    assert m.to_bytes() == mon.osdmap.to_bytes()
+
+
+def test_monitor_with_lost_quorum_rejects_commands():
+    mc = MonCluster(3)
+    leader = mc.elect()
+    mon = Monitor(
+        commit_fn=lambda incr: mc.commit(incr.to_bytes(), leader=leader)
+    )
+    mon.osd_crush_add(0)
+    mc.transport.partition((0,), (1, 2))
+    with pytest.raises(QuorumLost):
+        mon.osd_crush_add(1)
+    # nothing half-applied: the map never advanced
+    assert 1 not in mon.osdmap.osds
+    assert mon.osdmap.epoch == 1
