@@ -91,8 +91,40 @@ class Monitor:
     # -- commit path ----------------------------------------------------
     def _propose(self, **fields) -> OSDMap:
         """Build + commit one incremental; returns the new map. Caller
-        must hold the lock and call ``_flush`` after releasing it."""
+        must hold the lock and call ``_flush`` after releasing it.
+
+        Any change that moves CRUSH membership gets pg_temp overrides
+        for the affected PGs IN THE SAME EPOCH (old layout keeps
+        serving, zero unserved window); primaries backfill and then
+        clear them. The reference reaches the same steady state via
+        primary-requested pg_temp — committing both atomically removes
+        the race where a client reads the new layout before any
+        pg_temp lands."""
         incr = Incremental(epoch=self.osdmap.epoch + 1, **fields)
+        trial = self.osdmap.apply(incr)
+        temps = []
+        # only these fields alter CRUSH input (up/down flips and
+        # pg_temp edits cannot move membership) — skip the O(pools x
+        # pg_num) straw2 rescan on every other commit
+        crush_moving = any(
+            fields.get(f) for f in ("new_osds", "in_", "out")
+        )
+        for pool, spec in trial.pools.items() if crush_moving else ():
+            if pool not in self.osdmap.pools:
+                continue  # new pool: nothing to protect
+            for pgid in range(spec.pg_num):
+                if (pool, pgid) in trial.pg_temp:
+                    continue
+                old_raw = self.osdmap.pg_to_raw(pool, pgid, True)
+                if old_raw != trial.pg_to_raw(pool, pgid, True):
+                    temps.append((pool, pgid, tuple(old_raw)))
+        if temps:
+            incr = Incremental(
+                epoch=incr.epoch,
+                **{**fields, "new_pg_temp": tuple(
+                    list(fields.get("new_pg_temp", ())) + temps
+                )},
+            )
         if self._commit_fn is not None:
             self._commit_fn(incr)  # quorum may raise; nothing applied
         self.osdmap = self.osdmap.apply(incr)
@@ -306,3 +338,31 @@ class Monitor:
             if name not in self.osdmap.pools:
                 raise CommandError(f"no such pool: {name!r}")
             return self._propose(removed_pools=(name,))
+
+    # -- pg_temp (the backfill serving-layout override) -----------------
+    def pg_temp_set(
+        self, pool: str, pgid: int, acting: list[int]
+    ) -> OSDMap:
+        """A primary requests serving its PG from ``acting`` while it
+        backfills data to the CRUSH layout (OSDMonitor pg_temp)."""
+        with self._command():
+            if pool not in self.osdmap.pools:
+                raise CommandError(f"no such pool: {pool!r}")
+            spec = self.osdmap.pools[pool]
+            if len(acting) != spec.size:
+                raise CommandError(
+                    f"pg_temp wants {spec.size} positions, got {len(acting)}"
+                )
+            for o in acting:
+                if o != -1 and o not in self.osdmap.osds:
+                    raise CommandError(f"osd.{o} does not exist")
+            return self._propose(
+                new_pg_temp=((pool, pgid, tuple(acting)),)
+            )
+
+    def pg_temp_clear(self, pool: str, pgid: int) -> OSDMap | None:
+        """Backfill done: the PG serves from CRUSH again."""
+        with self._command():
+            if (pool, pgid) not in self.osdmap.pg_temp:
+                return None
+            return self._propose(del_pg_temp=((pool, pgid),))

@@ -40,6 +40,8 @@ from ceph_tpu.msg.messages import (
     ECSubWriteReply,
     OSDOp,
     OSDOpReply,
+    PGList,
+    PGListReply,
     Ping,
     Pong,
 )
@@ -59,9 +61,35 @@ from ceph_tpu.pipeline.rmw import (
     ShardBackend,
 )
 from ceph_tpu.pipeline.stripe import StripeInfo
-from ceph_tpu.store import MemStore
+from ceph_tpu.store import MemStore, Transaction
 
 from .osdmap import OSDMap, SHARD_NONE
+
+
+def make_loc(pool_id: int, oid: str) -> str:
+    """Pool-scoped store key: two pools writing the same client oid
+    must not collide in an OSD's flat object namespace (the hobject's
+    pool field, src/include/object.h)."""
+    return f"{pool_id}:{oid}"
+
+
+def split_loc(loc: str) -> tuple[int, str]:
+    pool_id, _, oid = loc.partition(":")
+    return int(pool_id), oid
+
+
+def shard_key(loc: str, shard: int) -> str:
+    """On-disk object name for ONE logical shard (the ghobject_t
+    shard_id field, src/common/hobject.h): an OSD can hold shard j of
+    an object under the old layout AND shard i under the new one while
+    backfill runs — distinct keys, so data movement never clobbers the
+    still-serving copy."""
+    return f"{loc}#s{shard}"
+
+
+def split_shard_key(key: str) -> tuple[str, int]:
+    loc, _, s = key.rpartition("#s")
+    return loc, int(s)
 
 
 class _AnyShardStores(dict):
@@ -101,40 +129,53 @@ class _PGBackend:
 
     def read_shard_async(self, shard, oid, extents, cb) -> None:
         osd = self.acting[shard]
+        key = shard_key(oid, shard)
         if osd == SHARD_NONE or (
             osd == self.daemon.osd_id
-            and self.daemon._misplaced(oid, shard)
+            and not self.daemon.store.exists(key)
         ):
+            # a live shard-holder ALWAYS has the object (every write
+            # touches it): absent means this store never got it —
+            # error, never zero-fill (that would decode garbage)
             self.daemon.peers._inbox.put(
-                lambda: cb(shard, ShardReadError(shard, oid))
+                lambda: cb(shard, ShardReadError(shard, oid, kind="missing"))
             )
         elif osd == self.daemon.osd_id:
             self.daemon.local.read_shard_async(
-                self.daemon.osd_id, oid, extents,
+                self.daemon.osd_id, key, extents,
                 lambda _s, res: cb(shard, res),
             )
         else:
             self.daemon.peers.read_shard_async(
-                osd, oid, extents, lambda _s, res: cb(shard, res),
+                osd, key, extents, lambda _s, res: cb(shard, res),
                 logical=shard,
             )
 
     def read_shard(self, shard, oid, extents):
         osd = self.acting[shard]
+        key = shard_key(oid, shard)
         if osd == self.daemon.osd_id:
-            if self.daemon._misplaced(oid, shard):
-                raise ShardReadError(shard, oid, kind="misplaced")
+            if not self.daemon.store.exists(key):
+                raise ShardReadError(shard, oid, kind="missing")
             return self.daemon.local.read_shard(
-                self.daemon.osd_id, oid, extents
+                self.daemon.osd_id, key, extents
             )
         return self.daemon.peers.read_shard(
-            osd, oid, extents, logical=shard
+            osd, key, extents, logical=shard
         )
 
     def submit_shard_txn(self, shard, txn, ack) -> None:
+        from dataclasses import replace as _dc_replace
+
         osd = self.acting[shard]
         if osd == SHARD_NONE:
             return  # parked: recovery's problem once the shard returns
+        txn = Transaction(
+            ops=[
+                _dc_replace(op, oid=shard_key(op.oid, shard))
+                for op in txn.ops
+            ]
+        )
         if osd == self.daemon.osd_id:
             self.daemon.local.submit_shard_txn(self.daemon.osd_id, txn, ack)
         else:
@@ -156,6 +197,9 @@ class _PG:
         profile = dict(daemon.osdmap.profiles[spec.profile_name])
         self.raw = list(raw)        # CRUSH membership (rebalance id)
         self.acting = list(acting)  # raw with down members as holes
+        self.backfilling = False    # pg_temp installed, data moving
+        self.backfill_dirty: set[str] = set()  # written mid-backfill
+        self.backfill_done = False  # moved; drop on next map change
         self.codec = registry.factory(spec.plugin, profile)
         chunk = daemon.chunk_size
         self.sinfo = StripeInfo(spec.k, spec.m, spec.k * chunk)
@@ -189,6 +233,7 @@ class OSDDaemon:
         store=None,
         chunk_size: int = 4096,
         op_timeout: float = 15.0,
+        tick_period: float = 2.0,
     ) -> None:
         self.osd_id = osd_id
         self.monitor = monitor
@@ -202,6 +247,10 @@ class OSDDaemon:
         self.messenger.set_dispatcher(self._dispatch)
         self.addr: tuple[str, int] | None = None
         self._pgs: dict[tuple[str, int], _PG] = {}
+        self._backfills: dict[tuple[str, int], threading.Thread] = {}
+        self.tick_period = tick_period
+        self._tick_stop: threading.Event | None = None
+        self._tick_thread: threading.Thread | None = None
         self._op_lock = threading.Lock()   # serializes client ops
         self._pg_lock = threading.Lock()   # guards _pgs + peer addrs
         self._stopped = False
@@ -211,10 +260,26 @@ class OSDDaemon:
         self.addr = self.messenger.bind(host, port)
         self.monitor.osd_boot(self.osd_id, self.addr)
         self.monitor.subscribe(self._on_map)
+        if self.tick_period > 0:
+            self._tick_stop = threading.Event()
+            self._tick_thread = threading.Thread(
+                target=self._tick_loop, daemon=True
+            )
+            self._tick_thread.start()
         return self.addr
+
+    def _tick_loop(self) -> None:
+        while not self._tick_stop.wait(self.tick_period):
+            try:
+                self.tick()
+            except Exception:
+                pass  # a failed tick must not kill the retry loop
 
     def stop(self) -> None:
         self._stopped = True
+        if self._tick_stop is not None:
+            self._tick_stop.set()
+            self._tick_thread.join(timeout=2.0)
         self.peers.shutdown()
         self.messenger.shutdown()
 
@@ -239,13 +304,39 @@ class OSDDaemon:
                         self.peers.down_shards.discard(osd)
                 else:
                     self.peers.down_shards.add(osd)
+            maybe_backfill: list[tuple[str, int, "_PG"]] = []
             for key, pg in list(self._pgs.items()):
                 pool, pgid = key
                 spec = osdmap.pools.get(pool)
-                if spec is None or osdmap.pg_to_raw(pool, pgid) != pg.raw:
-                    # membership changed: data must MOVE (backfill);
-                    # drop the PG — until backfill lands, reads fail
-                    # cleanly via the misplaced-shard guard
+                if spec is None:
+                    del self._pgs[key]
+                    continue
+                if osdmap.pg_to_raw(pool, pgid) != pg.raw:
+                    if pg.backfill_done:
+                        # this PG's data already moved to the CRUSH
+                        # layout; retire the old-layout instance
+                        del self._pgs[key]
+                        continue
+                    # membership changed: data must MOVE. If I'm the
+                    # serving primary, install pg_temp (keep serving
+                    # from the old layout) and backfill to the CRUSH
+                    # target; otherwise drop — reads fail cleanly via
+                    # the misplaced-shard guard until someone
+                    # backfills. The pg_temp request commits a map
+                    # change (recursive _on_map), so it runs after
+                    # this lock is released.
+                    primary = next(
+                        (o for o in pg.acting if o != SHARD_NONE),
+                        SHARD_NONE,
+                    )
+                    if (
+                        primary == self.osd_id
+                        and (pool, pgid) not in osdmap.pg_temp
+                    ):
+                        maybe_backfill.append((pool, pgid, pg))
+                        continue
+                    if (pool, pgid) in osdmap.pg_temp:
+                        continue  # serving via pg_temp; backfilling
                     del self._pgs[key]
                     continue
                 new_acting = osdmap.pg_to_up_acting(pool, pgid)
@@ -269,6 +360,28 @@ class OSDDaemon:
         for pg, healed in to_recover:
             for shard in healed:
                 self._catch_up_shard(pg, shard)
+        for pool, pgid, pg in maybe_backfill:
+            if self._request_pg_temp(pool, pgid, pg):
+                self._start_backfill(pool, pgid, pg)
+            else:
+                with self._pg_lock:
+                    self._pgs.pop((pool, pgid), None)
+        # temp-head adoption: whoever serves as primary under a
+        # pg_temp mapping drives its backfill (covers temps installed
+        # by OTHER daemons and primaries without a PG instance)
+        self._adopt_pg_temps()
+
+    def _adopt_pg_temps(self) -> None:
+        osdmap = self.osdmap
+        for (pool, pgid) in list(osdmap.pg_temp):
+            if pool not in osdmap.pools:
+                continue
+            acting = osdmap.pg_to_up_acting(pool, pgid)
+            primary = next((o for o in acting if o != SHARD_NONE), SHARD_NONE)
+            if primary != self.osd_id:
+                continue
+            pg = self._get_pg(pool, pgid)
+            self._start_backfill(pool, pgid, pg)
 
     def _catch_up_shard(self, pg: _PG, shard: int) -> None:
         """Replay the op log onto a returned member until it is clean
@@ -301,17 +414,32 @@ class OSDDaemon:
             return pg
 
     # -- object-info recovery (new-primary takeover) --------------------
+    def _my_key(self, pg: _PG, oid: str) -> str | None:
+        """My shard key for this object, from my acting position."""
+        try:
+            pos = pg.acting.index(self.osd_id)
+        except ValueError:
+            return None
+        return shard_key(oid, pos)
+
+    def _have_object(self, pg: _PG, oid: str) -> bool:
+        key = self._my_key(pg, oid)
+        return key is not None and self.store.exists(key)
+
     def _object_size(self, pg: _PG, oid: str) -> int:
         size = pg.rmw.object_size(oid)
         if size:
             return size
+        key = self._my_key(pg, oid)
+        if key is None:
+            return 0
         try:
-            size = int(self.store.getattr(oid, OI_KEY).decode())
+            size = int(self.store.getattr(key, OI_KEY).decode())
         except (FileNotFoundError, KeyError):
             return 0
         hinfo = None
         try:
-            hinfo = HashInfo.from_bytes(self.store.getattr(oid, HINFO_KEY))
+            hinfo = HashInfo.from_bytes(self.store.getattr(key, HINFO_KEY))
         except (FileNotFoundError, KeyError, ValueError):
             pass
         pg.rmw.prime_object(oid, size, hinfo)
@@ -329,6 +457,8 @@ class OSDDaemon:
             )
         elif isinstance(msg, ECSubRead):
             self._handle_sub_read(conn, msg)
+        elif isinstance(msg, PGList):
+            self._handle_pg_list(conn, msg)
         elif isinstance(msg, OSDOp):
             self._handle_client_op(conn, msg)
 
@@ -346,24 +476,38 @@ class OSDDaemon:
                     )
                 )
 
-        if msg.logical is not None and self._misplaced(msg.oid, msg.logical):
-            conn.send(ECSubReadReply(msg.tid, msg.shard, error="misplaced"))
+        if msg.logical is not None and not self.store.exists(msg.oid):
+            conn.send(ECSubReadReply(msg.tid, msg.shard, error="missing"))
             return
         self.local.read_shard_async(
             self.osd_id, msg.oid,
             ExtentSet((s, e) for s, e in msg.extents), reply,
         )
 
-    def _misplaced(self, oid: str, logical: int) -> bool:
-        """True when this store's bytes belong to a DIFFERENT logical
-        shard than the caller expects (post-remap, pre-backfill): the
-        SI attr travels with every sub-write exactly so this check can
-        turn would-be silent corruption into a clean shard error."""
-        try:
-            held = int(self.store.getattr(oid, SI_KEY).decode())
-        except (FileNotFoundError, KeyError, ValueError):
-            return False  # absent object/attr: plain short read
-        return held != logical
+    def _handle_pg_list(self, conn: Connection, msg: PGList) -> None:
+        """Backfill scan service: which of this PG's objects do I
+        hold, which logical shard are they, how big is the object.
+        Placement math from the message, not my (possibly old) map."""
+        from ceph_tpu.placement import stable_hash
+
+        oids = []
+        for key in self.store.list_objects():
+            try:
+                loc, si = split_shard_key(key)
+                pool_id, oid = split_loc(loc)
+            except ValueError:
+                continue
+            if pool_id != msg.pool_id:
+                continue
+            if stable_hash(str(msg.pool_id), oid) % msg.pg_num != msg.pgid:
+                continue
+            size = -1
+            try:
+                size = int(self.store.getattr(key, OI_KEY).decode())
+            except (FileNotFoundError, KeyError, ValueError):
+                pass
+            oids.append((loc, si, size))
+        conn.send(PGListReply(msg.tid, msg.shard, oids))
 
     # -- client ops (the PrimaryLogPG::do_op role) ----------------------
     def _handle_client_op(self, conn: Connection, msg: OSDOp) -> None:
@@ -377,13 +521,15 @@ class OSDDaemon:
 
     def _execute_client_op(self, msg: OSDOp) -> OSDOpReply:
         epoch = self.osdmap.epoch
-        if msg.pool not in self.osdmap.pools:
+        spec = self.osdmap.pools.get(msg.pool)
+        if spec is None:
             return OSDOpReply(msg.tid, epoch, error="enoent")
         acting = self.osdmap.object_to_acting(msg.pool, msg.oid)
         primary = next((o for o in acting if o != SHARD_NONE), SHARD_NONE)
         if primary != self.osd_id:
             return OSDOpReply(msg.tid, epoch, error="eagain")
         pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
+        msg.oid = make_loc(spec.pool_id, msg.oid)  # pool-scoped store key
         with self._op_lock:
             pg = self._get_pg(msg.pool, pgid)
             if msg.op == "write":
@@ -392,7 +538,7 @@ class OSDDaemon:
                 return self._op_read(pg, msg)
             if msg.op == "stat":
                 size = self._object_size(pg, msg.oid)
-                if not size and not self.store.exists(msg.oid):
+                if not size and not self._have_object(pg, msg.oid):
                     return OSDOpReply(msg.tid, epoch, error="enoent")
                 return OSDOpReply(msg.tid, epoch, size=size)
             if msg.op == "remove":
@@ -413,13 +559,16 @@ class OSDDaemon:
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
             )
+        if pg.backfilling:
+            with self._pg_lock:
+                pg.backfill_dirty.add(msg.oid)  # re-pushed pre-cutover
         return OSDOpReply(
             msg.tid, self.osdmap.epoch, size=pg.rmw.object_size(msg.oid)
         )
 
     def _op_read(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
         size = self._object_size(pg, msg.oid)
-        if not size and not self.store.exists(msg.oid):
+        if not size and not self._have_object(pg, msg.oid):
             return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
         length = msg.length if msg.length else max(size - msg.offset, 0)
         done: list = []
@@ -438,8 +587,8 @@ class OSDDaemon:
         )
 
     def _op_remove(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
-        if not self._object_size(pg, msg.oid) and not self.store.exists(
-            msg.oid
+        if not self._object_size(pg, msg.oid) and not self._have_object(
+            pg, msg.oid
         ):
             return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
         done: list = []
@@ -451,7 +600,236 @@ class OSDDaemon:
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
             )
+        if pg.backfilling:
+            with self._pg_lock:
+                pg.backfill_dirty.add(msg.oid)
         return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    # -- backfill (rebalance data movement, pg_temp-protected) ----------
+    def _request_pg_temp(self, pool: str, pgid: int, pg: _PG) -> bool:
+        try:
+            self.monitor.pg_temp_set(pool, pgid, list(pg.raw))
+            return True
+        except Exception:
+            return False
+
+    def _start_backfill(self, pool: str, pgid: int, pg: _PG) -> None:
+        key = (pool, pgid)
+        with self._pg_lock:
+            if key in self._backfills and self._backfills[key].is_alive():
+                return
+            t = threading.Thread(
+                target=self._backfill_pg, args=(pool, pgid, pg), daemon=True
+            )
+            self._backfills[key] = t
+        pg.backfilling = True
+        t.start()
+
+    def tick(self) -> None:
+        """Periodic maintenance: restart stalled backfills for PGs I
+        serve under pg_temp (a failed pass leaves the temp mapping in
+        place; the tick is the retry seam)."""
+        self._adopt_pg_temps()
+
+    def _backfill_pg(self, pool: str, pgid: int, pg: _PG) -> None:
+        """Move every object of the PG to its CRUSH target layout,
+        then drop pg_temp (the reference's backfill machinery:
+        interval scan + push, last_backfill semantics collapsed to a
+        dirty-set re-pass + final quiesce under the op lock)."""
+        try:
+            spec = self.osdmap.pools[pool]
+            # pass 1: scan + move everything currently known
+            hints = self._backfill_scan(pool, pgid, spec, pg)
+            for oid in sorted(hints):
+                # clear the dirty flag BEFORE pushing: a client write
+                # landing mid-push re-marks it and the final pass
+                # re-pushes; discarding after would erase that evidence
+                with self._pg_lock:
+                    pg.backfill_dirty.discard(oid)
+                self._backfill_object(pool, pgid, pg, oid, hints[oid])
+            # final pass: writes that landed mid-backfill, under the
+            # op lock so nothing new sneaks in; then drop pg_temp
+            with self._op_lock:
+                while True:
+                    with self._pg_lock:
+                        dirty = set(pg.backfill_dirty)
+                        pg.backfill_dirty.clear()
+                    if not dirty:
+                        break
+                    for oid in sorted(dirty):
+                        self._backfill_object(pool, pgid, pg, oid)
+                pg.backfilling = False
+                pg.backfill_done = True  # _on_map drops, not re-temps
+                self.monitor.pg_temp_clear(pool, pgid)
+            self._backfill_gc(pool, pgid, pg, spec)
+        except Exception:
+            # survivors short / peer died mid-pass: keep pg_temp (the
+            # PG stays served from the old layout); tick() retries
+            pg.backfilling = False
+
+    def _backfill_scan(
+        self, pool: str, pgid: int, spec, pg: _PG
+    ) -> dict[str, int]:
+        """Union of the PG's oids across my store and every reachable
+        member of both layouts (old holders + targets with partial
+        prior pushes), with the best known ro size per oid — the size
+        hint covers objects the primary's own store is missing."""
+        oids: dict[str, int] = {}
+        from ceph_tpu.placement import stable_hash
+
+        for key in self.store.list_objects():
+            try:
+                loc, _si = split_shard_key(key)
+                pool_id, oid = split_loc(loc)
+            except ValueError:
+                continue
+            if (
+                pool_id == spec.pool_id
+                and stable_hash(str(spec.pool_id), oid) % spec.pg_num == pgid
+            ):
+                oids[loc] = -1
+        peers = (set(pg.acting) | set(
+            self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
+        )) - {SHARD_NONE, self.osd_id}
+        for osd in sorted(peers):
+            if osd not in self.peers.avail_shards():
+                continue
+            try:
+                for oid, _si, size in self.peers.list_pg(
+                    osd, spec.pool_id, spec.pg_num, pgid
+                ):
+                    oids[oid] = max(oids.get(oid, -1), size)
+            except Exception:
+                continue  # scan is best-effort; pushes verify reality
+        return oids
+
+    def _backfill_object(
+        self, pool: str, pgid: int, pg: _PG, oid: str,
+        size_hint: int = -1,
+    ) -> None:
+        """Push one object's shards to the CRUSH target layout."""
+        from ceph_tpu.pipeline.read import get_min_avail_to_read_shards
+        from ceph_tpu.pipeline.shard_map import ShardExtentMap
+
+        target = self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
+        size = self._object_size(pg, oid)
+        exists = bool(size) or self._have_object(pg, oid)
+        if not exists and size_hint > 0:
+            # a peer holds it even though my store doesn't (written
+            # while my position was a hole): not a delete
+            size, exists = size_hint, True
+            pg.rmw.prime_object(oid, size)
+        reachable = self.peers.avail_shards() | {self.osd_id}
+        moves = [
+            i for i, tgt in enumerate(target)
+            if tgt != SHARD_NONE and tgt != pg.acting[i]
+            and tgt in reachable  # a down target would wedge the push;
+            # it catches up via log recovery when it returns
+        ]
+        if not moves:
+            return
+        if not exists:
+            # removed mid-backfill: propagate the delete to targets
+            for i in moves:
+                self._push_shard_txn(
+                    target[i],
+                    Transaction().touch(shard_key(oid, i)).remove(
+                        shard_key(oid, i)
+                    ),
+                )
+            return
+        shard_len = pg.sinfo.object_size_to_shard_size(size, 0)
+        want = {i: ExtentSet([(0, shard_len)]) for i in moves}
+        avail = pg.backend.avail_shards()
+        reads, need_decode = get_min_avail_to_read_shards(
+            pg.sinfo, pg.codec, want, avail
+        )
+        smap = ShardExtentMap(pg.sinfo)
+        for sr in reads.values():
+            for start, buf in pg.backend.read_shard(
+                sr.shard, oid, sr.extents
+            ).items():
+                smap.insert(sr.shard, start, buf)
+        if need_decode:
+            smap.decode(pg.codec, {i for i in moves if i not in avail}, size)
+        hinfo = pg.rmw.hinfo(oid)
+        my_key = self._my_key(pg, oid)
+        try:
+            hinfo_bytes = (
+                hinfo.to_bytes() if hinfo is not None
+                else self.store.getattr(my_key, HINFO_KEY)
+                if my_key is not None else None
+            )
+        except (FileNotFoundError, KeyError):
+            hinfo_bytes = None
+        for i in moves:
+            key = shard_key(oid, i)
+            buf = bytes(smap.get(i, 0, shard_len))
+            txn = Transaction().touch(key).write(key, 0, buf)
+            txn.truncate(key, shard_len)
+            if hinfo_bytes is not None:
+                txn.setattr(key, HINFO_KEY, hinfo_bytes)
+            txn.setattr(key, OI_KEY, str(size).encode())
+            txn.setattr(key, SI_KEY, str(i).encode())
+            self._push_shard_txn(target[i], txn)
+
+    def _push_shard_txn(self, osd: int, txn) -> None:
+        """Synchronous push to one osd (local or peer)."""
+        if osd == self.osd_id:
+            self.store.queue_transactions(txn)
+            return
+        done: list = []
+        self.peers.submit_shard_txn(osd, txn, lambda: done.append(1))
+        self.peers.drain_until(lambda: bool(done), timeout=self.op_timeout)
+
+    def _backfill_gc(
+        self, pool: str, pgid: int, pg: _PG, spec
+    ) -> None:
+        """Drop copies that don't belong to the new layout: ex-members
+        lose all their pg keys; members that changed position lose the
+        old position's key (shard-scoped keys make this precise)."""
+        target = self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
+        members = (set(pg.acting) | set(target)) - {SHARD_NONE}
+        for osd in sorted(members):
+            if osd == self.osd_id:
+                held = []
+                from ceph_tpu.placement import stable_hash
+
+                for key in self.store.list_objects():
+                    try:
+                        loc, si = split_shard_key(key)
+                        pool_id, oid = split_loc(loc)
+                    except ValueError:
+                        continue
+                    if (
+                        pool_id == spec.pool_id
+                        and stable_hash(str(spec.pool_id), oid)
+                        % spec.pg_num == pgid
+                    ):
+                        held.append((loc, si))
+            else:
+                if osd not in self.peers.avail_shards():
+                    continue  # unreachable: stale copies are inert
+                             # (shard keys can't be misread as current)
+                try:
+                    held = [
+                        (loc, si) for loc, si, _sz in self.peers.list_pg(
+                            osd, spec.pool_id, spec.pg_num, pgid
+                        )
+                    ]
+                except Exception:
+                    continue
+            for loc, si in held:
+                keep = 0 <= si < len(target) and target[si] == osd
+                if keep:
+                    continue
+                key = shard_key(loc, si)
+                try:
+                    self._push_shard_txn(
+                        osd, Transaction().touch(key).remove(key)
+                    )
+                except Exception:
+                    pass
 
     # -- failure detection ----------------------------------------------
     def report_down_peers(self) -> None:

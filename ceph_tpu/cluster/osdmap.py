@@ -125,6 +125,10 @@ class Incremental:
     new_pools: tuple[PoolSpec, ...] = ()
     removed_pools: tuple[str, ...] = ()
     new_profiles: tuple[tuple[str, tuple[tuple[str, str], ...]], ...] = ()
+    #: pg_temp installs: ((pool, pgid, (osd, ...)), ...) — the PG
+    #: serves from this membership until backfill completes
+    new_pg_temp: tuple[tuple[str, int, tuple[int, ...]], ...] = ()
+    del_pg_temp: tuple[tuple[str, int], ...] = ()
 
     def to_bytes(self) -> bytes:
         return json.dumps({
@@ -139,6 +143,11 @@ class Incremental:
             "new_profiles": [
                 [n, [list(kv) for kv in prof]] for n, prof in self.new_profiles
             ],
+            "new_pg_temp": [
+                [pool, pgid, list(acting)]
+                for pool, pgid, acting in self.new_pg_temp
+            ],
+            "del_pg_temp": [list(k) for k in self.del_pg_temp],
         }).encode()
 
     @classmethod
@@ -157,6 +166,11 @@ class Incremental:
                 (n, tuple(tuple(kv) for kv in prof))
                 for n, prof in o["new_profiles"]
             ),
+            tuple(
+                (pool, pgid, tuple(acting))
+                for pool, pgid, acting in o.get("new_pg_temp", ())
+            ),
+            tuple(tuple(k) for k in o.get("del_pg_temp", ())),
         )
 
 
@@ -169,6 +183,7 @@ class OSDMap:
         osds: dict[int, OSDInfo] | None = None,
         pools: dict[str, PoolSpec] | None = None,
         profiles: dict[str, dict[str, str]] | None = None,
+        pg_temp: dict[tuple[str, int], tuple[int, ...]] | None = None,
     ) -> None:
         self.epoch = epoch
         self.osds: dict[int, OSDInfo] = dict(osds or {})
@@ -176,6 +191,11 @@ class OSDMap:
         self.profiles: dict[str, dict[str, str]] = {
             k: dict(v) for k, v in (profiles or {}).items()
         }
+        #: (pool, pgid) -> temporary membership serving the PG while
+        #: backfill moves data to the CRUSH mapping (OSDMap pg_temp)
+        self.pg_temp: dict[tuple[str, int], tuple[int, ...]] = dict(
+            pg_temp or {}
+        )
         # straw2 input: in-devices with positive weight. Down-but-in
         # devices STAY (holes, not movement).
         self._crush = CrushMap([
@@ -189,14 +209,23 @@ class OSDMap:
         spec = self._pool(pool)
         return stable_hash(str(spec.pool_id), oid) % spec.pg_num
 
-    def pg_to_raw(self, pool: str, pg: int) -> list[int]:
-        """CRUSH membership for a PG, ignoring up/down: position i is
-        EC shard i. This is the REBALANCE identity — it changes only
-        when devices are added/removed/reweighted/outed, never on a
-        liveness flip, so callers can tell 'same members, one down'
-        (heal + log recovery) from 'different members' (backfill).
-        Short when the cluster has fewer in-devices than k+m."""
+    def pg_to_raw(
+        self, pool: str, pg: int, ignore_temp: bool = False
+    ) -> list[int]:
+        """Membership for a PG, ignoring up/down: position i is EC
+        shard i. A pg_temp override wins (the PG serves from its OLD
+        layout while backfill runs); ``ignore_temp`` asks for the pure
+        CRUSH mapping — the backfill TARGET. This is the REBALANCE
+        identity — it changes only when devices are added/removed/
+        reweighted/outed (or pg_temp flips), never on a liveness flip,
+        so callers can tell 'same members, one down' (heal + log
+        recovery) from 'different members' (backfill). Short when the
+        cluster has fewer in-devices than k+m."""
         spec = self._pool(pool)
+        if not ignore_temp:
+            temp = self.pg_temp.get((pool, pg))
+            if temp is not None:
+                return list(temp)
         n = min(spec.size, len(self._crush.devices))
         raw = self._crush.select(
             stable_hash(str(spec.pool_id), pg),
@@ -268,7 +297,16 @@ class OSDMap:
         profiles = {k: dict(v) for k, v in self.profiles.items()}
         for name, prof in incr.new_profiles:
             profiles[name] = dict(prof)
-        return OSDMap(self.epoch + 1, osds, pools, profiles)
+        pg_temp = dict(self.pg_temp)
+        for pool, pgid, acting in incr.new_pg_temp:
+            pg_temp[(pool, pgid)] = tuple(acting)
+        for key in incr.del_pg_temp:
+            pg_temp.pop(tuple(key), None)
+        for name in incr.removed_pools:
+            pg_temp = {
+                k: v for k, v in pg_temp.items() if k[0] != name
+            }
+        return OSDMap(self.epoch + 1, osds, pools, profiles, pg_temp)
 
     # -- serialization --------------------------------------------------
     def to_bytes(self) -> bytes:
@@ -277,6 +315,10 @@ class OSDMap:
             "osds": [o.to_obj() for o in self.osds.values()],
             "pools": [p.to_obj() for p in self.pools.values()],
             "profiles": self.profiles,
+            "pg_temp": [
+                [pool, pgid, list(acting)]
+                for (pool, pgid), acting in self.pg_temp.items()
+            ],
         }).encode()
 
     @classmethod
@@ -287,6 +329,10 @@ class OSDMap:
             {x["id"]: OSDInfo.from_obj(x) for x in o["osds"]},
             {x["name"]: PoolSpec.from_obj(x) for x in o["pools"]},
             o["profiles"],
+            {
+                (pool, pgid): tuple(acting)
+                for pool, pgid, acting in o.get("pg_temp", ())
+            },
         )
 
     def __repr__(self) -> str:

@@ -30,6 +30,8 @@ MSG_PING = 112                # MOSDPing analog (heartbeats)
 MSG_PONG = 113
 MSG_OSD_OP = 114              # MOSDOp (client op to the primary)
 MSG_OSD_OP_REPLY = 115        # MOSDOpReply
+MSG_PG_LIST = 116             # backfill object discovery
+MSG_PG_LIST_REPLY = 117
 
 VERSION = 1
 
@@ -279,6 +281,65 @@ class OSDOpReply:
         return cls(h["tid"], h["epoch"], h["error"], h["size"], segments[1])
 
 
+@dataclass
+class PGList:
+    """Ask a peer which objects of one PG it holds (the backfill
+    scan — the reference's backfill interval scan over the PG
+    collection). Placement params travel in the message so the peer
+    answers correctly even with a lagging map."""
+
+    tid: int
+    shard: int  # echo key for reply routing (the peer's osd id)
+    pool_id: int
+    pg_num: int
+    pgid: int
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "pg_list",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "pool_id": self.pool_id,
+                    "pg_num": self.pg_num,
+                    "pgid": self.pgid,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "PGList":
+        h = _parse(segments[0], "pg_list")
+        return cls(h["tid"], h["shard"], h["pool_id"], h["pg_num"], h["pgid"])
+
+
+@dataclass
+class PGListReply:
+    """Oids this peer holds for the PG, with the logical shard index
+    each one's bytes belong to (the SI attr) and the stored ro size."""
+
+    tid: int
+    shard: int
+    oids: list[tuple[str, int, int]] = field(default_factory=list)
+    # (oid, held_shard_index or -1 if unknown, ro_size or -1)
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "pg_list_reply",
+                {"tid": self.tid, "shard": self.shard, "oids": self.oids},
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "PGListReply":
+        h = _parse(segments[0], "pg_list_reply")
+        return cls(
+            h["tid"], h["shard"], [tuple(o) for o in h["oids"]]
+        )
+
+
 _DECODERS = {
     MSG_EC_SUB_WRITE: ECSubWrite.decode,
     MSG_EC_SUB_WRITE_REPLY: ECSubWriteReply.decode,
@@ -288,6 +349,8 @@ _DECODERS = {
     MSG_PONG: Pong.decode,
     MSG_OSD_OP: OSDOp.decode,
     MSG_OSD_OP_REPLY: OSDOpReply.decode,
+    MSG_PG_LIST: PGList.decode,
+    MSG_PG_LIST_REPLY: PGListReply.decode,
 }
 
 _TYPE_OF = {
@@ -299,6 +362,8 @@ _TYPE_OF = {
     Pong: MSG_PONG,
     OSDOp: MSG_OSD_OP,
     OSDOpReply: MSG_OSD_OP_REPLY,
+    PGList: MSG_PG_LIST,
+    PGListReply: MSG_PG_LIST_REPLY,
 }
 
 

@@ -37,6 +37,8 @@ from .messages import (
     ECSubReadReply,
     ECSubWrite,
     ECSubWriteReply,
+    PGList,
+    PGListReply,
     Ping,
     Pong,
 )
@@ -156,7 +158,9 @@ class NetShardBackend:
         if isinstance(msg, Pong):
             self._last_seen[msg.shard] = time.monotonic()
             return
-        if not isinstance(msg, (ECSubWriteReply, ECSubReadReply)):
+        if not isinstance(
+            msg, (ECSubWriteReply, ECSubReadReply, PGListReply)
+        ):
             return  # a reflected request must never satisfy an RPC
         with self._lock:
             entry = self._waiting.pop((msg.tid, msg.shard), None)
@@ -274,6 +278,26 @@ class NetShardBackend:
         if isinstance(result, Exception):
             raise result
         return result
+
+    def list_pg(
+        self, shard: int, pool_id: int, pg_num: int, pgid: int
+    ) -> list[tuple[str, int, int]]:
+        """Synchronous backfill scan: which objects of this PG does the
+        peer hold, as (oid, held_shard_index, ro_size) tuples."""
+        tid = next(self._tids)
+        out: dict[str, object] = {}
+        self._register(
+            tid, shard, "", lambda r: out.update(r=r), is_read=True
+        )
+        if not self._send(
+            shard, PGList(tid, shard, pool_id, pg_num, pgid), tid
+        ):
+            raise ConnectionError(f"osd.{shard} unreachable for pg list")
+        self.drain_until(lambda: "r" in out, timeout=self.timeout + 5)
+        result = out["r"]
+        if isinstance(result, Exception):
+            raise result
+        return result.oids
 
     def submit_shard_txn(
         self, shard: int, txn: Transaction, ack: Callable[[], None]

@@ -96,18 +96,30 @@ def test_down_makes_holes_not_movement():
 
 
 def test_out_remaps_the_hole():
-    """Marking out removes the device from crush input: the hole is
-    refilled by a substitute device (rebalance)."""
+    """Marking out removes the device from crush input: the CRUSH
+    target refills the hole with a substitute, while an auto-installed
+    pg_temp keeps the PG SERVING from the old layout (hole included)
+    until backfill moves the data and clears it."""
     mon = mk_monitor(8)
     m = mk_pool(mon)
     acting = m.object_to_acting("ecpool", "obj")
     victim = acting[0]
     mon.osd_down(victim)
     m2 = mon.osd_out(victim)
-    after = m2.object_to_acting("ecpool", "obj")
-    assert victim not in after
-    assert SHARD_NONE not in after
-    assert len(set(after)) == 6
+    pgid = m2.object_to_pg("ecpool", "obj")
+    # serving layout: still the old membership, victim's slot a hole
+    served = m2.object_to_acting("ecpool", "obj")
+    assert served[0] == SHARD_NONE
+    assert served[1:] == acting[1:]
+    assert (("ecpool", pgid)) in m2.pg_temp
+    # CRUSH target: victim gone, hole refilled by a substitute
+    target = m2.pg_to_raw("ecpool", pgid, ignore_temp=True)
+    assert victim not in target
+    assert SHARD_NONE not in target
+    assert len(set(target)) == 6
+    # backfill completion clears the override: acting = target
+    m3 = mon.pg_temp_clear("ecpool", pgid)
+    assert m3.object_to_acting("ecpool", "obj") == target
 
 
 def test_minimal_movement_on_out():
