@@ -101,30 +101,31 @@ class Monitor:
         the race where a client reads the new layout before any
         pg_temp lands."""
         incr = Incremental(epoch=self.osdmap.epoch + 1, **fields)
-        trial = self.osdmap.apply(incr)
-        temps = []
         # only these fields alter CRUSH input (up/down flips and
-        # pg_temp edits cannot move membership) — skip the O(pools x
-        # pg_num) straw2 rescan on every other commit
+        # pg_temp edits cannot move membership) — skip the trial map
+        # and the O(pools x pg_num) straw2 rescan on every other commit
         crush_moving = any(
             fields.get(f) for f in ("new_osds", "in_", "out")
         )
-        for pool, spec in trial.pools.items() if crush_moving else ():
-            if pool not in self.osdmap.pools:
-                continue  # new pool: nothing to protect
-            for pgid in range(spec.pg_num):
-                if (pool, pgid) in trial.pg_temp:
-                    continue
-                old_raw = self.osdmap.pg_to_raw(pool, pgid, True)
-                if old_raw != trial.pg_to_raw(pool, pgid, True):
-                    temps.append((pool, pgid, tuple(old_raw)))
-        if temps:
-            incr = Incremental(
-                epoch=incr.epoch,
-                **{**fields, "new_pg_temp": tuple(
-                    list(fields.get("new_pg_temp", ())) + temps
-                )},
-            )
+        if crush_moving:
+            trial = self.osdmap.apply(incr)
+            temps = []
+            for pool, spec in trial.pools.items():
+                if pool not in self.osdmap.pools:
+                    continue  # new pool: nothing to protect
+                for pgid in range(spec.pg_num):
+                    if (pool, pgid) in trial.pg_temp:
+                        continue
+                    old_raw = self.osdmap.pg_to_raw(pool, pgid, True)
+                    if old_raw != trial.pg_to_raw(pool, pgid, True):
+                        temps.append((pool, pgid, tuple(old_raw)))
+            if temps:
+                incr = Incremental(
+                    epoch=incr.epoch,
+                    **{**fields, "new_pg_temp": tuple(
+                        list(fields.get("new_pg_temp", ())) + temps
+                    )},
+                )
         if self._commit_fn is not None:
             self._commit_fn(incr)  # quorum may raise; nothing applied
         self.osdmap = self.osdmap.apply(incr)

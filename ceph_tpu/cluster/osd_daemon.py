@@ -62,6 +62,7 @@ from ceph_tpu.pipeline.rmw import (
 )
 from ceph_tpu.pipeline.stripe import StripeInfo
 from ceph_tpu.store import MemStore, Transaction
+from ceph_tpu.utils.mclock import MClockScheduler
 
 from .osdmap import OSDMap, SHARD_NONE
 
@@ -234,6 +235,7 @@ class OSDDaemon:
         chunk_size: int = 4096,
         op_timeout: float = 15.0,
         tick_period: float = 2.0,
+        scheduler_profiles=None,
     ) -> None:
         self.osd_id = osd_id
         self.monitor = monitor
@@ -251,6 +253,13 @@ class OSDDaemon:
         self.tick_period = tick_period
         self._tick_stop: threading.Event | None = None
         self._tick_thread: threading.Thread | None = None
+        #: mClock QoS arbitration between client IO and background
+        #: work (the osd/scheduler/mClockScheduler seam): client ops
+        #: run ON the worker in tag order; recovery/backfill admit
+        #: through it (their IO still runs on their own threads)
+        self.scheduler = MClockScheduler(scheduler_profiles)
+        self._sched_cv = threading.Condition()
+        self._worker: threading.Thread | None = None
         self._op_lock = threading.Lock()   # serializes client ops
         self._pg_lock = threading.Lock()   # guards _pgs + peer addrs
         self._stopped = False
@@ -266,7 +275,44 @@ class OSDDaemon:
                 target=self._tick_loop, daemon=True
             )
             self._tick_thread.start()
+        self._worker = threading.Thread(target=self._worker_loop, daemon=True)
+        self._worker.start()
         return self.addr
+
+    def _worker_loop(self) -> None:
+        """The op-queue worker (the OSD shard thread role): pulls
+        work in mClock tag order and runs it."""
+        import time as _time
+
+        while not self._stopped:
+            with self._sched_cv:
+                got = self.scheduler.dequeue()
+                if got is None:
+                    nr = self.scheduler.next_ready()
+                    wait = 0.2
+                    if nr is not None:
+                        wait = max(0.001, min(nr - _time.monotonic(), 0.2))
+                    self._sched_cv.wait(wait)
+                    continue
+            _cls, fn = got
+            try:
+                fn()
+            except Exception:
+                pass  # op errors reply themselves; never kill the worker
+
+    def _schedule(self, class_name: str, fn, cost: float = 1.0) -> None:
+        with self._sched_cv:
+            self.scheduler.enqueue(class_name, fn, cost)
+            self._sched_cv.notify()
+
+    def admit(self, class_name: str, cost: float = 1.0) -> None:
+        """QoS admission gate for background work: blocks until the
+        scheduler grants a slot. Times out permissively (work proceeds
+        unthrottled rather than deadlocking when the worker is stuck
+        behind a lock the caller holds)."""
+        ev = threading.Event()
+        self._schedule(class_name, ev.set, cost)
+        ev.wait(timeout=self.op_timeout)
 
     def _tick_loop(self) -> None:
         while not self._tick_stop.wait(self.tick_period):
@@ -277,6 +323,10 @@ class OSDDaemon:
 
     def stop(self) -> None:
         self._stopped = True
+        with self._sched_cv:
+            self._sched_cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=2.0)
         if self._tick_stop is not None:
             self._tick_stop.set()
             self._tick_thread.join(timeout=2.0)
@@ -390,6 +440,7 @@ class OSDDaemon:
         reverts to a hole; the next map change retries."""
         try:
             for _ in range(8):
+                self.admit("recovery")
                 pg.recovery.recover_from_log(pg.pglog, shard)
                 if not pg.pglog.dirty_extents(shard) and not (
                     pg.pglog.dirty_deletes(shard)
@@ -414,6 +465,27 @@ class OSDDaemon:
             return pg
 
     # -- object-info recovery (new-primary takeover) --------------------
+    def _scan_pg_keys(
+        self, pool_id: int, pg_num: int, pgid: int
+    ) -> list[tuple[str, int]]:
+        """Own-store scan: (loc, shard_index) pairs of this PG's keys
+        (shared by the PGList service, backfill scan, and GC)."""
+        from ceph_tpu.placement import stable_hash
+
+        out = []
+        for key in self.store.list_objects():
+            try:
+                loc, si = split_shard_key(key)
+                pool_id2, oid = split_loc(loc)
+            except ValueError:
+                continue
+            if (
+                pool_id2 == pool_id
+                and stable_hash(str(pool_id), oid) % pg_num == pgid
+            ):
+                out.append((loc, si))
+        return out
+
     def _my_key(self, pg: _PG, oid: str) -> str | None:
         """My shard key for this object, from my acting position."""
         try:
@@ -491,19 +563,12 @@ class OSDDaemon:
         from ceph_tpu.placement import stable_hash
 
         oids = []
-        for key in self.store.list_objects():
-            try:
-                loc, si = split_shard_key(key)
-                pool_id, oid = split_loc(loc)
-            except ValueError:
-                continue
-            if pool_id != msg.pool_id:
-                continue
-            if stable_hash(str(msg.pool_id), oid) % msg.pg_num != msg.pgid:
-                continue
+        for loc, si in self._scan_pg_keys(msg.pool_id, msg.pg_num, msg.pgid):
             size = -1
             try:
-                size = int(self.store.getattr(key, OI_KEY).decode())
+                size = int(
+                    self.store.getattr(shard_key(loc, si), OI_KEY).decode()
+                )
             except (FileNotFoundError, KeyError, ValueError):
                 pass
             oids.append((loc, si, size))
@@ -511,9 +576,19 @@ class OSDDaemon:
 
     # -- client ops (the PrimaryLogPG::do_op role) ----------------------
     def _handle_client_op(self, conn: Connection, msg: OSDOp) -> None:
+        """Reader thread: enqueue in mClock order; the worker runs it
+        (OSD::enqueue_op -> mClock queue -> dequeue_op, osd/OSD.cc:
+        9874,9933). Cost scales with payload so a large write consumes
+        proportionally more of the class's rate."""
+        cost = 1.0 + max(len(msg.data), msg.length) / 65536.0
+        self._schedule(
+            "client", lambda: self._run_client_op(conn, msg), cost
+        )
+
+    def _run_client_op(self, conn: Connection, msg: OSDOp) -> None:
         try:
             reply = self._execute_client_op(msg)
-        except Exception as e:  # never kill the dispatch loop
+        except Exception as e:  # never kill the worker
             reply = OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio", data=str(e).encode()
             )
@@ -641,6 +716,9 @@ class OSDDaemon:
             # pass 1: scan + move everything currently known
             hints = self._backfill_scan(pool, pgid, spec, pg)
             for oid in sorted(hints):
+                # QoS: each object move admits through the backfill
+                # class so client IO keeps its reservation
+                self.admit("backfill")
                 # clear the dirty flag BEFORE pushing: a client write
                 # landing mid-push re-marks it and the final pass
                 # re-pushes; discarding after would erase that evidence
@@ -675,19 +753,8 @@ class OSDDaemon:
         prior pushes), with the best known ro size per oid — the size
         hint covers objects the primary's own store is missing."""
         oids: dict[str, int] = {}
-        from ceph_tpu.placement import stable_hash
-
-        for key in self.store.list_objects():
-            try:
-                loc, _si = split_shard_key(key)
-                pool_id, oid = split_loc(loc)
-            except ValueError:
-                continue
-            if (
-                pool_id == spec.pool_id
-                and stable_hash(str(spec.pool_id), oid) % spec.pg_num == pgid
-            ):
-                oids[loc] = -1
+        for loc, _si in self._scan_pg_keys(spec.pool_id, spec.pg_num, pgid):
+            oids[loc] = -1
         peers = (set(pg.acting) | set(
             self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
         )) - {SHARD_NONE, self.osd_id}
@@ -792,21 +859,7 @@ class OSDDaemon:
         members = (set(pg.acting) | set(target)) - {SHARD_NONE}
         for osd in sorted(members):
             if osd == self.osd_id:
-                held = []
-                from ceph_tpu.placement import stable_hash
-
-                for key in self.store.list_objects():
-                    try:
-                        loc, si = split_shard_key(key)
-                        pool_id, oid = split_loc(loc)
-                    except ValueError:
-                        continue
-                    if (
-                        pool_id == spec.pool_id
-                        and stable_hash(str(spec.pool_id), oid)
-                        % spec.pg_num == pgid
-                    ):
-                        held.append((loc, si))
+                held = self._scan_pg_keys(spec.pool_id, spec.pg_num, pgid)
             else:
                 if osd not in self.peers.avail_shards():
                     continue  # unreachable: stale copies are inert
