@@ -18,6 +18,7 @@ from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec, SHARD_NONE
 from .monitor import CommandError, Monitor
 from .objecter import IoCtx, NoPrimary, Objecter, RadosClient
 from .osd_daemon import OSDDaemon
+from .striper import StripedIoCtx
 
 __all__ = [
     "CommandError",
@@ -31,5 +32,6 @@ __all__ = [
     "Objecter",
     "PoolSpec",
     "RadosClient",
+    "StripedIoCtx",
     "SHARD_NONE",
 ]

@@ -186,6 +186,38 @@ class _PGBackend:
         self.daemon.peers.drain_until(pred, timeout)
 
 
+class _ScrubStore:
+    """One shard's store as ``be_deep_scrub`` expects it, backed by
+    the PG's (possibly remote) shard reads."""
+
+    def __init__(self, pg: "_PG", shard: int) -> None:
+        self.pg = pg
+        self.shard = shard
+
+    def read(self, oid: str, offset: int, length: int) -> bytes:
+        try:
+            bufs = self.pg.backend.read_shard(
+                self.shard, oid, ExtentSet([(offset, offset + length)])
+            )
+        except Exception:
+            raise FileNotFoundError(oid) from None
+        return b"".join(bufs[o] for o in sorted(bufs))
+
+
+class _ScrubBackendView:
+    """Adapter giving ``be_deep_scrub`` its backend surface
+    (avail_shards + stores[shard].read) over a cluster PG."""
+
+    def __init__(self, pg: "_PG") -> None:
+        self.pg = pg
+        self.stores = {
+            s: _ScrubStore(pg, s) for s in range(len(pg.acting))
+        }
+
+    def avail_shards(self) -> set[int]:
+        return self.pg.backend.avail_shards()
+
+
 class _PG:
     """Primary-side state for one placement group. Holds the full
     per-PG pipeline stack the reference's PG object holds: RMW, reads,
@@ -883,6 +915,75 @@ class OSDDaemon:
                     )
                 except Exception:
                     pass
+
+    # -- deep scrub (be_deep_scrub over the wire + repair) --------------
+    def scrub_pg(
+        self, pool: str, pgid: int, repair: bool = False
+    ) -> "list":
+        """Deep-scrub every object of a PG I lead: read each live
+        shard's hashed window, verify against the persisted HashInfo
+        cumulative CRCs (ECBackend.cc:1829-1869 — the verify loop IS
+        ``pipeline.recovery.be_deep_scrub``, run over the wire through
+        an adapter), and with ``repair`` rebuild mismatched shards from
+        the good ones. Objects are enumerated across MY store and every
+        reachable member (the same union scan backfill uses) so a
+        primary missing its own shard key still scrubs the object."""
+        spec = self.osdmap.pools[pool]
+        pg = self._get_pg(pool, pgid)
+        locs = sorted(self._backfill_scan(pool, pgid, spec, pg))
+        results = []
+        for loc in locs:
+            self.admit("scrub")
+            # serialize with client ops: a scrub racing a mid-commit
+            # write would see mixed-epoch shards and (with repair)
+            # write the mixture back
+            with self._op_lock:
+                results.append(self._scrub_object(pg, loc, repair))
+        return results
+
+    def _scrub_object(self, pg: _PG, oid: str, repair: bool):
+        from ceph_tpu.pipeline.recovery import (
+            ScrubError,
+            ScrubResult,
+            be_deep_scrub,
+        )
+
+        self._object_size(pg, oid)  # primes rmw size+hinfo for repair
+        hinfo = pg.rmw.hinfo(oid)
+        if hinfo is None:
+            key = self._my_key(pg, oid)
+            try:
+                hinfo = HashInfo.from_bytes(
+                    self.store.getattr(key, HINFO_KEY)
+                )
+            except (FileNotFoundError, KeyError, TypeError, ValueError):
+                result = ScrubResult(oid)
+                result.errors.append(ScrubError(-1, "missing_attr"))
+                return result
+        result = be_deep_scrub(
+            pg.sinfo, _ScrubBackendView(pg), oid, hinfo=hinfo
+        )
+        bad = sorted({e.shard for e in result.errors if e.shard >= 0})
+        if repair and bad:
+            try:
+                pg.recovery.recover_object(oid, set(bad))
+                result.repaired = True
+            except Exception as e:
+                result.errors.append(ScrubError(-1, "read_error", str(e)))
+        return result
+
+    def scrub_all(self, repair: bool = False) -> "dict":
+        """Scrub every PG this daemon currently leads."""
+        out = {}
+        for pool, spec in self.osdmap.pools.items():
+            for pgid in range(spec.pg_num):
+                acting = self.osdmap.pg_to_up_acting(pool, pgid)
+                primary = next(
+                    (o for o in acting if o != SHARD_NONE), SHARD_NONE
+                )
+                if primary == self.osd_id:
+                    out[(pool, pgid)] = self.scrub_pg(pool, pgid, repair)
+        return out
 
     # -- failure detection ----------------------------------------------
     def report_down_peers(self) -> None:

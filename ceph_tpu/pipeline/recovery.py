@@ -321,6 +321,7 @@ class ScrubError:
 class ScrubResult:
     oid: str
     errors: list[ScrubError] = field(default_factory=list)
+    repaired: bool = False
 
     @property
     def ok(self) -> bool:

@@ -1,0 +1,104 @@
+"""Cluster thrashing — the teuthology thrash-erasure-code tier over
+the REAL mini-cluster (qa/suites/rados/thrash-erasure-code*,
+qa/tasks/ceph_manager.py kill/revive): random OSD deaths and revivals
+under live client IO, model-checked contents, scrub-repair
+convergence at the end."""
+
+import numpy as np
+from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+
+
+K, M = 3, 2
+N_OSD = 6
+
+
+def test_thrash_kill_revive_under_io():
+    rng = np.random.default_rng(1234)
+    mon = Monitor()
+    daemons: dict[int, OSDDaemon] = {}
+    stores: dict[int, object] = {}
+    for i in range(N_OSD):
+        mon.osd_crush_add(i)
+    for i in range(N_OSD):
+        d = OSDDaemon(i, mon, chunk_size=1024, tick_period=0.2)
+        d.start()
+        daemons[i] = d
+        stores[i] = d.store
+    mon.osd_erasure_code_profile_set(
+        "rs32", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": str(K), "m": str(M)}
+    )
+    mon.osd_pool_create("ecpool", 4, "rs32")
+    client = RadosClient(mon, backoff=0.02)
+    io = client.open_ioctx("ecpool")
+
+    model: dict[str, bytes] = {}
+    dead: list[int] = []
+    obj_seq = 0
+
+    def do_io(n_ops: int) -> None:
+        nonlocal obj_seq
+        for _ in range(n_ops):
+            op = rng.choice(["write", "read", "remove"])
+            if op == "write" or not model:
+                oid = f"obj{obj_seq}"
+                obj_seq += 1
+                blob = rng.integers(
+                    0, 256, int(rng.integers(500, 8_000)), dtype=np.uint8
+                ).tobytes()
+                io.write(oid, blob)
+                model[oid] = blob
+            elif op == "read":
+                oid = sorted(model)[int(rng.integers(0, len(model)))]
+                assert io.read(oid) == model[oid], f"stale read of {oid}"
+            else:
+                oid = sorted(model)[int(rng.integers(0, len(model)))]
+                io.remove(oid)
+                del model[oid]
+
+    def kill(osd: int) -> None:
+        daemons[osd].stop()
+        mon.osd_down(osd)
+        dead.append(osd)
+
+    def revive(osd: int) -> None:
+        d = OSDDaemon(
+            osd, mon, store=stores[osd], chunk_size=1024, tick_period=0.2
+        )
+        d.start()  # boots + log recovery catches the shard up
+        daemons[osd] = d
+        dead.remove(osd)
+
+    def scrub_repair_everywhere() -> None:
+        # primaries repair any staleness the log couldn't cover (a
+        # revived member whose primary changed while it was gone)
+        for _ in range(2):
+            for d in list(daemons.values()):
+                if d.osd_id not in dead:
+                    d.scrub_all(repair=True)
+
+    do_io(10)
+    for round_no in range(4):
+        # kill 1-2 OSDs, never dropping below k live
+        kills = int(rng.integers(1, M + 1))
+        for _ in range(kills):
+            if N_OSD - len(dead) - 1 < K:
+                break
+            candidates = [i for i in range(N_OSD) if i not in dead]
+            kill(int(rng.choice(candidates)))
+        do_io(8)  # degraded IO must keep working
+        while dead:
+            revive(dead[0])
+        scrub_repair_everywhere()
+        do_io(5)
+
+    # final convergence: every object readable and bit-exact, scrub clean
+    for oid, blob in sorted(model.items()):
+        assert io.read(oid) == blob
+    for d in daemons.values():
+        for (pool, pgid), results in d.scrub_all().items():
+            for r in results:
+                assert r.ok, f"{r.oid}: {r.errors}"
+    client.shutdown()
+    for d in daemons.values():
+        d.stop()
