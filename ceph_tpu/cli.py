@@ -1,0 +1,361 @@
+"""``ceph``/``rados``-style CLI over a persistent dev cluster — the
+vstart.sh + src/tools/rados analog (SURVEY.md §4 tier 3: the
+standalone-cluster ops surface).
+
+State lives in a directory: ``mon/store.log`` (the persistent monitor
+DB — every committed map epoch) and ``osd.N/`` FileStore trees. Each
+invocation boots the cluster from that state, executes one command,
+and shuts down — like driving a vstart cluster with the ceph CLI:
+
+    python -m ceph_tpu.cli -d /tmp/c vstart --osds 6
+    python -m ceph_tpu.cli -d /tmp/c profile-set rs62 plugin=jerasure \\
+        technique=reed_sol_van k=4 m=2
+    python -m ceph_tpu.cli -d /tmp/c pool-create mypool 16 rs62
+    python -m ceph_tpu.cli -d /tmp/c put mypool obj ./file
+    python -m ceph_tpu.cli -d /tmp/c get mypool obj ./out
+    python -m ceph_tpu.cli -d /tmp/c ls mypool
+    python -m ceph_tpu.cli -d /tmp/c status
+    python -m ceph_tpu.cli -d /tmp/c osd-down 3
+    python -m ceph_tpu.cli -d /tmp/c scrub --repair
+    python -m ceph_tpu.cli -d /tmp/c bench mypool --size 65536 --count 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+from ceph_tpu.cluster.mon_store import MonStore
+from ceph_tpu.cluster.osd_daemon import SHARD_NONE, split_loc, split_shard_key
+from ceph_tpu.store import FileStore
+
+
+class Cluster:
+    """Boot the persistent dev cluster from a state dir."""
+
+    def __init__(self, root: str, quiet: bool = True) -> None:
+        self.root = root
+        self.mon_store = MonStore(os.path.join(root, "mon", "store.log"))
+        initial, history = self.mon_store.replay()
+        self.mon = Monitor(
+            initial=initial, commit_fn=self.mon_store.append,
+            history=history,
+        )
+        self.daemons: dict[int, OSDDaemon] = {}
+        for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
+            if not name.startswith("osd."):
+                continue
+            osd = int(name.split(".", 1)[1])
+            if os.path.exists(os.path.join(root, name, "stopped")):
+                continue  # operator stopped it (osd-down marker)
+            store = FileStore(os.path.join(root, name))
+            d = OSDDaemon(osd, self.mon, store=store)
+            d.start()
+            self.daemons[osd] = d
+        # anything in the map but not on disk is gone: mark it down
+        for osd in sorted(self.mon.osdmap.up_osds() - set(self.daemons)):
+            self.mon.osd_down(osd)
+        self.client = RadosClient(self.mon, backoff=0.02)
+
+    def add_osd(self, osd: int, zone: str = "") -> None:
+        self.mon.osd_crush_add(osd, zone=zone)
+        store = FileStore(os.path.join(self.root, f"osd.{osd}"))
+        d = OSDDaemon(osd, self.mon, store=store)
+        d.start()
+        self.daemons[osd] = d
+
+    def settle(self, timeout: float = 60.0) -> None:
+        """Wait for pending backfills (pg_temp) to clear."""
+        end = time.monotonic() + timeout
+        while self.mon.osdmap.pg_temp and time.monotonic() < end:
+            time.sleep(0.05)
+
+    def shutdown(self) -> None:
+        self.settle(timeout=5.0)
+        self.client.shutdown()
+        for d in self.daemons.values():
+            d.stop()
+
+    # -- object listing (the rados ls role: union of shard scans) ------
+    def list_objects(self, pool: str) -> list[str]:
+        spec = self.mon.osdmap.pools[pool]
+        oids = set()
+        for d in self.daemons.values():
+            for key in d.store.list_objects():
+                try:
+                    loc, _si = split_shard_key(key)
+                    pool_id, oid = split_loc(loc)
+                except ValueError:
+                    continue
+                if pool_id == spec.pool_id:
+                    oids.add(oid)
+        return sorted(oids)
+
+
+def cmd_vstart(cl: Cluster, args) -> int:
+    existing = set(cl.daemons)
+    for i in range(args.osds):
+        if i not in existing:
+            cl.add_osd(i, zone=f"z{i % max(args.zones, 1)}")
+    print(f"cluster up: {len(cl.daemons)} osds, epoch "
+          f"{cl.mon.osdmap.epoch}, dir {cl.root}")
+    return 0
+
+
+def cmd_status(cl: Cluster, args) -> int:
+    m = cl.mon.osdmap
+    up = sorted(m.up_osds())
+    print(f"epoch {m.epoch}")
+    print(f"osds: {len(m.osds)} total, {len(up)} up {up}")
+    for name, spec in sorted(m.pools.items()):
+        degraded = sum(
+            1 for pg in range(spec.pg_num)
+            if SHARD_NONE in m.pg_to_up_acting(name, pg)
+        )
+        state = f"{degraded} degraded pgs" if degraded else "clean"
+        print(
+            f"pool {name!r}: id {spec.pool_id}, {spec.pg_num} pgs, "
+            f"EC {spec.k}+{spec.m} ({spec.plugin}/"
+            f"{spec.profile_name}), {state}"
+        )
+    if m.pg_temp:
+        print(f"backfilling: {sorted(m.pg_temp)}")
+    return 0
+
+
+def cmd_osd_tree(cl: Cluster, args) -> int:
+    m = cl.mon.osdmap
+    for osd, info in sorted(m.osds.items()):
+        state = ("up" if info.up else "down") + "/" + (
+            "in" if info.in_ else "out"
+        )
+        addr = f"{info.addr[0]}:{info.addr[1]}" if info.addr else "-"
+        print(
+            f"osd.{osd}\tweight {info.weight:.2f}\tzone "
+            f"{info.zone or '-'}\t{state}\t{addr}"
+        )
+    return 0
+
+
+def cmd_profile_set(cl: Cluster, args) -> int:
+    profile = dict(kv.split("=", 1) for kv in args.kv)
+    cl.mon.osd_erasure_code_profile_set(args.name, profile, force=args.force)
+    print(f"profile {args.name!r} = {profile}")
+    return 0
+
+
+def cmd_pool_create(cl: Cluster, args) -> int:
+    cl.mon.osd_pool_create(
+        args.name, args.pg_num, args.profile,
+        distinct_zones=args.distinct_zones,
+    )
+    spec = cl.mon.osdmap.pools[args.name]
+    print(f"pool {args.name!r} created: EC {spec.k}+{spec.m}, "
+          f"{spec.pg_num} pgs")
+    return 0
+
+
+def cmd_put(cl: Cluster, args) -> int:
+    data = (
+        sys.stdin.buffer.read() if args.file == "-"
+        else open(args.file, "rb").read()
+    )
+    io = cl.client.open_ioctx(args.pool)
+    io.write_full(args.oid, data)
+    print(f"wrote {len(data)} bytes to {args.pool}/{args.oid}")
+    return 0
+
+
+def cmd_get(cl: Cluster, args) -> int:
+    io = cl.client.open_ioctx(args.pool)
+    data = io.read(args.oid)
+    if args.file == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(args.file, "wb") as f:
+            f.write(data)
+        print(f"read {len(data)} bytes from {args.pool}/{args.oid}")
+    return 0
+
+
+def cmd_rm(cl: Cluster, args) -> int:
+    cl.client.open_ioctx(args.pool).remove(args.oid)
+    print(f"removed {args.pool}/{args.oid}")
+    return 0
+
+
+def cmd_ls(cl: Cluster, args) -> int:
+    for oid in cl.list_objects(args.pool):
+        print(oid)
+    return 0
+
+
+def cmd_stat(cl: Cluster, args) -> int:
+    size = cl.client.open_ioctx(args.pool).stat(args.oid)
+    print(f"{args.pool}/{args.oid}: {size} bytes")
+    return 0
+
+
+def cmd_osd_down(cl: Cluster, args) -> int:
+    d = cl.daemons.pop(args.osd, None)
+    if d is not None:
+        d.stop()
+    open(os.path.join(cl.root, f"osd.{args.osd}", "stopped"), "w").close()
+    cl.mon.osd_down(args.osd)
+    print(f"osd.{args.osd} stopped + marked down")
+    return 0
+
+
+def cmd_osd_up(cl: Cluster, args) -> int:
+    marker = os.path.join(cl.root, f"osd.{args.osd}", "stopped")
+    if os.path.exists(marker):
+        os.unlink(marker)
+    if args.osd not in cl.daemons:
+        store = FileStore(os.path.join(cl.root, f"osd.{args.osd}"))
+        d = OSDDaemon(args.osd, cl.mon, store=store)
+        d.start()
+        cl.daemons[args.osd] = d
+    cl.settle()
+    print(f"osd.{args.osd} restarted")
+    return 0
+
+
+def cmd_osd_out(cl: Cluster, args) -> int:
+    cl.mon.osd_out(args.osd)
+    cl.settle()
+    print(f"osd.{args.osd} marked out; rebalance settled")
+    return 0
+
+
+def cmd_osd_in(cl: Cluster, args) -> int:
+    cl.mon.osd_in(args.osd)
+    cl.settle()
+    print(f"osd.{args.osd} marked in; rebalance settled")
+    return 0
+
+
+def cmd_scrub(cl: Cluster, args) -> int:
+    total = bad = repaired = 0
+    for d in list(cl.daemons.values()):
+        for (pool, pgid), results in d.scrub_all(repair=args.repair).items():
+            for r in results:
+                total += 1
+                if not r.ok:
+                    bad += 1
+                    print(f"{pool}/{pgid} {r.oid}: "
+                          + "; ".join(
+                              f"shard {e.shard} {e.kind} {e.detail}"
+                              for e in r.errors))
+                if r.repaired:
+                    repaired += 1
+    print(f"scrubbed {total} objects: {bad} inconsistent, "
+          f"{repaired} repaired")
+    return 1 if (bad and not args.repair) else 0
+
+
+def cmd_bench(cl: Cluster, args) -> int:
+    """The `rados bench` role: time writes then reads."""
+    import numpy as np
+
+    io = cl.client.open_ioctx(args.pool)
+    blob = np.random.default_rng(0).integers(
+        0, 256, args.size, dtype=np.uint8
+    ).tobytes()
+    t0 = time.perf_counter()
+    for i in range(args.count):
+        io.write(f"bench_{i}", blob)
+    t_w = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(args.count):
+        assert io.read(f"bench_{i}") == blob
+    t_r = time.perf_counter() - t0
+    for i in range(args.count):
+        io.remove(f"bench_{i}")
+    mb = args.size * args.count / 1e6
+    print(json.dumps({
+        "write_MBps": round(mb / t_w, 2),
+        "read_MBps": round(mb / t_r, 2),
+        "ops": args.count,
+        "object_size": args.size,
+    }))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ceph_tpu.cli", description=__doc__.splitlines()[0]
+    )
+    p.add_argument("-d", "--dir", required=True, help="cluster state dir")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("vstart", help="create/boot a dev cluster")
+    s.add_argument("--osds", type=int, default=6)
+    s.add_argument("--zones", type=int, default=3)
+    s.set_defaults(fn=cmd_vstart)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+    sub.add_parser("osd-tree").set_defaults(fn=cmd_osd_tree)
+
+    s = sub.add_parser("profile-set")
+    s.add_argument("name")
+    s.add_argument("kv", nargs="+", help="key=value pairs")
+    s.add_argument("--force", action="store_true")
+    s.set_defaults(fn=cmd_profile_set)
+
+    s = sub.add_parser("pool-create")
+    s.add_argument("name")
+    s.add_argument("pg_num", type=int)
+    s.add_argument("profile", nargs="?", default="")
+    s.add_argument("--distinct-zones", action="store_true")
+    s.set_defaults(fn=cmd_pool_create)
+
+    for name, fn, extra in (
+        ("put", cmd_put, ["pool", "oid", "file"]),
+        ("get", cmd_get, ["pool", "oid", "file"]),
+        ("rm", cmd_rm, ["pool", "oid"]),
+        ("ls", cmd_ls, ["pool"]),
+        ("stat", cmd_stat, ["pool", "oid"]),
+    ):
+        s = sub.add_parser(name)
+        for a in extra:
+            s.add_argument(a)
+        s.set_defaults(fn=fn)
+
+    for name, fn in (
+        ("osd-down", cmd_osd_down),
+        ("osd-up", cmd_osd_up),
+        ("osd-out", cmd_osd_out),
+        ("osd-in", cmd_osd_in),
+    ):
+        s = sub.add_parser(name)
+        s.add_argument("osd", type=int)
+        s.set_defaults(fn=fn)
+
+    s = sub.add_parser("scrub")
+    s.add_argument("--repair", action="store_true")
+    s.set_defaults(fn=cmd_scrub)
+
+    s = sub.add_parser("bench")
+    s.add_argument("pool")
+    s.add_argument("--size", type=int, default=65536)
+    s.add_argument("--count", type=int, default=16)
+    s.set_defaults(fn=cmd_bench)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cl = Cluster(args.dir)
+    try:
+        return args.fn(cl, args)
+    finally:
+        cl.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Persistent monitor store — the mon RocksDB-store role
+(src/mon/MonitorDBStore.h: every Paxos-committed map change lands in a
+durable log; a restarting monitor replays it to the exact same map).
+
+Format: the shared crc-framed append-only log
+(``ceph_tpu.store.framed_log`` — the same framing FileStore's WAL
+uses) of serialized ``Incremental`` records. Replay applies them in
+order from the empty map and truncates any torn tail so post-crash
+appends can never land behind unreadable bytes. Epochs are contiguous
+by construction, so the rebuilt map is bit-identical to the one that
+committed (tested via to_bytes equality).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ceph_tpu.store import framed_log
+
+from .osdmap import Incremental, OSDMap
+
+
+class MonStore:
+    """Durable incremental log + replay."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def append(self, incr: Incremental) -> None:
+        framed_log.append(self.path, incr.to_bytes())
+
+    def replay(self) -> tuple[OSDMap, list[Incremental]]:
+        """Rebuild the map (and the incremental history) from the log."""
+        m = OSDMap()
+        incrs: list[Incremental] = []
+        for payload in framed_log.replay(self.path):
+            incr = Incremental.from_bytes(payload)
+            m = m.apply(incr)
+            incrs.append(incr)
+        return m, incrs

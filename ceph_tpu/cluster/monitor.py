@@ -50,6 +50,7 @@ class Monitor:
         initial: OSDMap | None = None,
         commit_fn: Callable[[Incremental], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
+        history: "list[Incremental] | None" = None,
     ) -> None:
         self.osdmap = initial or OSDMap()
         self._commit_fn = commit_fn
@@ -62,7 +63,17 @@ class Monitor:
         self._failure_reports: dict[int, set[int]] = {}
         #: osd id -> monotonic time it went down (for auto-out)
         self._down_since: dict[int, float] = {}
-        self._next_pool_id = 1
+        # resuming from a persisted map: pool ids must keep ascending
+        # past every id EVER issued (a removed pool's id must not be
+        # reused — stale shard keys on disk encode only the pool id,
+        # and a reused id would adopt them into the new pool), so the
+        # high-water mark comes from the full history when available
+        ever = [p.pool_id for p in self.osdmap.pools.values()]
+        for incr in history or ():
+            ever.extend(p.pool_id for p in incr.new_pools)
+        self._next_pool_id = 1 + max(ever, default=0)
+        for incr in history or ():
+            self._incrementals[incr.epoch] = incr
         #: committed maps awaiting subscriber delivery. Delivery
         #: happens OUTSIDE the monitor lock (``_flush``): subscribers
         #: do real work (an OSD daemon may drive recovery IO on a map
@@ -184,17 +195,21 @@ class Monitor:
                 up=prev.up if prev else False,
                 in_=prev.in_ if prev else False,
                 addr=prev.addr if prev else None,
+                new=prev.new if prev else True,
             )
             return self._propose(new_osds=(info,))
 
     def osd_boot(self, osd: int, addr: tuple[str, int]) -> OSDMap:
-        """An OSD came up and announced its address (MOSDBoot)."""
+        """An OSD came up and announced its address (MOSDBoot). A NEW
+        device is auto-marked in (mon_osd_auto_mark_new_in); a device
+        an operator marked out stays out until `osd in`."""
         with self._command():
             prev = self.osdmap.osds.get(osd)
             if prev is None:
                 raise CommandError(f"osd.{osd} not in crush map")
             info = OSDInfo(
-                osd, prev.weight, prev.zone, up=True, in_=True, addr=addr
+                osd, prev.weight, prev.zone, up=True,
+                in_=prev.in_ or prev.new, addr=addr, new=False,
             )
             self._failure_reports.pop(osd, None)
             self._down_since.pop(osd, None)

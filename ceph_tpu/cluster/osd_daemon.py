@@ -948,7 +948,12 @@ class OSDDaemon:
             be_deep_scrub,
         )
 
-        self._object_size(pg, oid)  # primes rmw size+hinfo for repair
+        if not self._object_size(pg, oid) and not self._have_object(
+            pg, oid
+        ):
+            # removed between enumeration and this lock: clean skip,
+            # not an inconsistency
+            return ScrubResult(oid)
         hinfo = pg.rmw.hinfo(oid)
         if hinfo is None:
             key = self._my_key(pg, oid)

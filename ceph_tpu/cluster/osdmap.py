@@ -36,7 +36,10 @@ SHARD_NONE = -1
 
 @dataclass(frozen=True)
 class OSDInfo:
-    """One device's map entry (osd_info_t + addrs + weights)."""
+    """One device's map entry (osd_info_t + addrs + weights).
+    ``new`` distinguishes a never-booted device (auto-marked in on
+    first boot, mon_osd_auto_mark_new_in) from one an operator marked
+    out — an OUT osd that reboots STAYS out until `osd in`."""
 
     id: int
     weight: float = 1.0
@@ -44,6 +47,7 @@ class OSDInfo:
     up: bool = False
     in_: bool = False
     addr: tuple[str, int] | None = None
+    new: bool = True
 
     def to_obj(self) -> dict:
         return {
@@ -53,6 +57,7 @@ class OSDInfo:
             "up": self.up,
             "in": self.in_,
             "addr": list(self.addr) if self.addr else None,
+            "new": self.new,
         }
 
     @classmethod
@@ -60,6 +65,7 @@ class OSDInfo:
         return cls(
             o["id"], o["weight"], o["zone"], o["up"], o["in"],
             tuple(o["addr"]) if o["addr"] else None,
+            o.get("new", False),
         )
 
 

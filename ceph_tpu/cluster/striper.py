@@ -96,11 +96,15 @@ class StripedIoCtx:
         self._bump_size(oid, offset + len(data))
 
     def read(self, oid: str, offset: int = 0, length: int | None = None) -> bytes:
-        if length is None:
-            size = self.stat(oid)
-            if offset >= size:
-                return b""
-            length = size - offset
+        # clamp to the logical size (raises for absent objects): reads
+        # past EOF short-read like rados, never fabricate zeros, and
+        # absence is an error, not a hole
+        size = self.stat(oid)
+        if offset >= size:
+            return b""
+        length = size - offset if length is None else min(
+            length, size - offset
+        )
         out = bytearray(length)
         pos = 0
         for idx, obj_off, run in self._extents(offset, length):

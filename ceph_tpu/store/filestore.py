@@ -24,14 +24,10 @@ from __future__ import annotations
 
 import json
 import os
-import struct
 import threading
 
-from ceph_tpu.checksum.host import crc32c as _crc
-
+from . import framed_log
 from .transaction import Op, OpKind, Transaction
-
-_JHDR = struct.Struct("<II")  # payload length, crc32c
 
 
 def _enc_name(oid: str) -> str:
@@ -57,19 +53,11 @@ class FileStore:
         a gone object is a no-op here, unlike the strict live path)."""
         if not os.path.exists(self.journal_path):
             return
-        with open(self.journal_path, "rb") as f:
-            raw = f.read()
-        pos = 0
         touched: set[str] = set()
-        while pos + _JHDR.size <= len(raw):
-            length, crc = _JHDR.unpack_from(raw, pos)
-            payload = raw[pos + _JHDR.size : pos + _JHDR.size + length]
-            if len(payload) < length or _crc(0xFFFFFFFF, payload) != crc:
-                break  # torn tail write: discard from here
+        for payload in framed_log.replay(self.journal_path):
             txn = Transaction.from_bytes(payload)
             self._apply(txn, strict=False)
             touched.update(op.oid for op in txn.ops)
-            pos += _JHDR.size + length
         # replayed state must be durable before the journal goes away
         self._fsync_objects(touched)
         os.unlink(self.journal_path)
@@ -84,16 +72,23 @@ class FileStore:
             #    failing op leaves no partial state, so check every op
             #    against simulated existence/attr state up front.
             self._validate(txns)
-            # 1. journal (durable intent)
-            with open(self.journal_path, "ab") as jf:
-                for txn in txns:
-                    payload = txn.to_bytes()
-                    jf.write(
-                        _JHDR.pack(len(payload), _crc(0xFFFFFFFF, payload))
-                    )
-                    jf.write(payload)
-                jf.flush()
-                os.fsync(jf.fileno())
+            # 1. journal (durable intent) — the journal FILE and its
+            #    directory entry must both be durable, or a crash
+            #    mid-apply could lose the journal itself and leave a
+            #    half-applied transaction with nothing to replay
+            for txn in txns:
+                framed_log.append(self.journal_path, txn.to_bytes(),
+                                  sync=False)
+            jf = os.open(self.journal_path, os.O_RDONLY)
+            try:
+                os.fsync(jf)
+            finally:
+                os.close(jf)
+            rd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(rd)
+            finally:
+                os.close(rd)
             # 2. apply
             for txn in txns:
                 self._apply(txn)

@@ -1,0 +1,161 @@
+"""CLI + persistent dev cluster (the vstart.sh + rados-tool tier):
+every invocation boots from the state dir, so state surviving between
+invocations exercises monitor-store replay AND FileStore recovery."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cli import main
+from ceph_tpu.cluster.mon_store import MonStore
+from ceph_tpu.cluster.osdmap import Incremental, OSDInfo, OSDMap
+
+
+def run(capsys, *argv) -> str:
+    rc = main(list(argv))
+    assert rc == 0, f"{argv} -> rc {rc}"
+    return capsys.readouterr().out
+
+
+@pytest.fixture
+def cdir(tmp_path):
+    return str(tmp_path / "cluster")
+
+
+def test_mon_store_replay_identity(tmp_path):
+    store = MonStore(str(tmp_path / "mon.log"))
+    m = OSDMap()
+    for i in range(3):
+        incr = Incremental(
+            epoch=i + 1,
+            new_osds=(OSDInfo(i, 1.0, f"z{i}", True, True, ("h", 7000 + i)),),
+        )
+        store.append(incr)
+        m = m.apply(incr)
+    replayed, incrs = store.replay()
+    assert replayed.to_bytes() == m.to_bytes()
+    assert len(incrs) == 3
+    # torn tail discarded
+    with open(store.path, "ab") as f:
+        f.write(b"\x99\x00\x00\x00garbage")
+    replayed2, _ = store.replay()
+    assert replayed2.to_bytes() == m.to_bytes()
+
+
+def test_full_cli_lifecycle_across_invocations(cdir, tmp_path, capsys):
+    """Each CLI call is a separate cluster boot: pools, profiles and
+    objects must survive via the mon store + FileStores."""
+    run(capsys, "-d", cdir, "vstart", "--osds", "6")
+    run(capsys, "-d", cdir, "profile-set", "rs42",
+        "plugin=jerasure", "technique=reed_sol_van", "k=4", "m=2")
+    run(capsys, "-d", cdir, "pool-create", "data", "8", "rs42")
+
+    blob = np.random.default_rng(3).integers(
+        0, 256, 50_000, dtype=np.uint8
+    ).tobytes()
+    src = tmp_path / "in.bin"
+    src.write_bytes(blob)
+    run(capsys, "-d", cdir, "put", "data", "obj1", str(src))
+
+    out = run(capsys, "-d", cdir, "status")
+    assert "pool 'data'" in out and "EC 4+2" in out and "clean" in out
+
+    dst = tmp_path / "out.bin"
+    run(capsys, "-d", cdir, "get", "data", "obj1", str(dst))
+    assert dst.read_bytes() == blob
+
+    out = run(capsys, "-d", cdir, "ls", "data")
+    assert out.split() == ["obj1"]
+    out = run(capsys, "-d", cdir, "stat", "data", "obj1")
+    assert "50000 bytes" in out
+
+    run(capsys, "-d", cdir, "rm", "data", "obj1")
+    with pytest.raises(FileNotFoundError):
+        main(["-d", cdir, "stat", "data", "obj1"])
+    capsys.readouterr()
+
+
+def test_pool_ids_never_reused_across_restarts(cdir, capsys):
+    """Removing the highest-id pool and restarting must not hand its
+    id to a new pool — stale shard keys on disk encode the pool id,
+    and a reused id would adopt dead objects into the new pool."""
+    run(capsys, "-d", cdir, "vstart", "--osds", "4")
+    run(capsys, "-d", cdir, "pool-create", "a", "4")  # id 1
+    run(capsys, "-d", cdir, "pool-create", "b", "4")  # id 2
+    from ceph_tpu.cli import Cluster
+
+    cl = Cluster(cdir)
+    try:
+        assert cl.mon.osdmap.pools["b"].pool_id == 2
+        cl.mon.osd_pool_rm("b")
+    finally:
+        cl.shutdown()
+    run(capsys, "-d", cdir, "pool-create", "c", "4")  # separate boot
+    cl = Cluster(cdir)
+    try:
+        assert cl.mon.osdmap.pools["c"].pool_id == 3  # not 2
+    finally:
+        cl.shutdown()
+
+
+def test_cli_degraded_service_and_rebalance(cdir, tmp_path, capsys):
+    run(capsys, "-d", cdir, "vstart", "--osds", "6")
+    run(capsys, "-d", cdir, "profile-set", "rs32",
+        "plugin=jerasure", "technique=reed_sol_van", "k=3", "m=2")
+    run(capsys, "-d", cdir, "pool-create", "p", "4", "rs32")
+    blob = b"payload" * 1000
+    src = tmp_path / "b.bin"
+    src.write_bytes(blob)
+    run(capsys, "-d", cdir, "put", "p", "x", str(src))
+    # kill an osd; next invocation serves degraded
+    run(capsys, "-d", cdir, "osd-down", "5")
+    dst = tmp_path / "o.bin"
+    run(capsys, "-d", cdir, "get", "p", "x", str(dst))
+    assert dst.read_bytes() == blob
+    # out it: backfill rebalances, still readable, status clean
+    run(capsys, "-d", cdir, "osd-out", "5")
+    out = run(capsys, "-d", cdir, "status")
+    assert "clean" in out
+    run(capsys, "-d", cdir, "get", "p", "x", str(dst))
+    assert dst.read_bytes() == blob
+
+
+def test_cli_down_persists_and_up_recovers(cdir, tmp_path, capsys):
+    """osd-down survives reboots (stopped marker) and osd-up brings
+    the daemon back with log recovery; an outed OSD stays out when it
+    reboots (auto_mark_new_in applies to NEW devices only)."""
+    run(capsys, "-d", cdir, "vstart", "--osds", "6")
+    run(capsys, "-d", cdir, "pool-create", "p", "4")
+    blob = b"abc" * 5000
+    src = tmp_path / "b.bin"
+    src.write_bytes(blob)
+    run(capsys, "-d", cdir, "put", "p", "x", str(src))
+    run(capsys, "-d", cdir, "osd-down", "1")
+    out = run(capsys, "-d", cdir, "osd-tree")  # separate boot
+    assert "osd.1\tweight 1.00\tzone z1\tdown/in" in out
+    # write while it's down, bring it back, then verify
+    blob2 = b"xyz" * 5000
+    src.write_bytes(blob2)
+    run(capsys, "-d", cdir, "put", "p", "x2", str(src))
+    run(capsys, "-d", cdir, "osd-up", "1")
+    out = run(capsys, "-d", cdir, "osd-tree")
+    assert "osd.1\tweight 1.00\tzone z1\tup/in" in out
+    dst = tmp_path / "o.bin"
+    run(capsys, "-d", cdir, "get", "p", "x2", str(dst))
+    assert dst.read_bytes() == blob2
+    # out + reboot: stays out
+    run(capsys, "-d", cdir, "osd-out", "1")
+    out = run(capsys, "-d", cdir, "osd-tree")
+    assert "osd.1\tweight 1.00\tzone z1\tup/out" in out
+    run(capsys, "-d", cdir, "osd-in", "1")
+    out = run(capsys, "-d", cdir, "osd-tree")
+    assert "osd.1\tweight 1.00\tzone z1\tup/in" in out
+
+
+def test_cli_scrub_and_bench(cdir, tmp_path, capsys):
+    run(capsys, "-d", cdir, "vstart", "--osds", "5")
+    run(capsys, "-d", cdir, "pool-create", "p", "4")  # default profile
+    out = run(capsys, "-d", cdir, "bench", "p", "--size", "8192",
+              "--count", "4")
+    assert "write_MBps" in out
+    out = run(capsys, "-d", cdir, "scrub")
+    assert "0 inconsistent" in out
