@@ -112,12 +112,20 @@ class ErasureCodeBase:
     # -- shared shard plumbing ----------------------------------------
     def _stack_data(self, data: dict[int, jax.Array]) -> jax.Array:
         """dict -> [..., k, N]; absent shards are zero (the shared
-        zero-buffer convention of the reference's encode_chunks)."""
+        zero-buffer convention of the reference's encode_chunks).
+        All-numpy inputs stack on the host so small ops can take the
+        host GF path without a device round-trip; anything already on
+        device stacks there."""
         sample = next(iter(data.values()))
+        xp = (
+            np
+            if all(isinstance(v, np.ndarray) for v in data.values())
+            else jnp
+        )
         shards = [
-            data.get(i, jnp.zeros_like(sample)) for i in range(self.k)
+            data.get(i, xp.zeros_like(sample)) for i in range(self.k)
         ]
-        return jnp.stack(shards, axis=-2)
+        return xp.stack(shards, axis=-2)
 
     # -- byte-level wrappers (legacy-interface parity) ----------------
     def encode_prepare(self, data: bytes) -> jax.Array:

@@ -70,6 +70,7 @@ class MatrixErasureCodec(ErasureCodeBase):
         self.generator: np.ndarray | None = None  # [(k+m), k] uint8
         self._encode_bmat: jax.Array | None = None
         self._tables = DecodeTableCache()
+        self._host_tables = DecodeTableCache()  # byte matrices
 
     # Subclasses set self.k/self.m then call this from init().
     def _set_generator(self, generator: np.ndarray) -> None:
@@ -100,12 +101,32 @@ class MatrixErasureCodec(ErasureCodeBase):
             self.k + i: parity[..., i, :] for i in range(self.m)
         }
 
+    @staticmethod
+    def _host_sized(*arrays) -> bool:
+        """Small host-side inputs skip device dispatch entirely: below
+        the threshold, tunnel/launch latency dwarfs the GF math."""
+        from ceph_tpu.utils import config
+
+        limit = config.get("ec_host_dispatch_bytes")
+        return (
+            limit > 0
+            and all(isinstance(a, np.ndarray) for a in arrays)
+            and sum(a.nbytes for a in arrays) <= limit
+        )
+
     def _encode_stacked(self, stacked: jax.Array) -> jax.Array:
-        """Dispatch the parity matmul: the fused Pallas MXU kernel on
-        TPU when the shape tiles (config-gated), einsum otherwise."""
+        """Dispatch the parity matmul: host GF tables for small numpy
+        inputs, the fused Pallas MXU kernel on TPU when the shape
+        tiles (config-gated), einsum otherwise."""
         from ceph_tpu.ops import pallas_encode as pe
         from ceph_tpu.utils import config
 
+        if self._host_sized(stacked):
+            from ceph_tpu.gf import gf_apply_bytes_host
+
+            return gf_apply_bytes_host(
+                self.generator[self.k :, :], stacked
+            )
         lead = stacked.shape[:-2]
         flat_shape = (-1,) + stacked.shape[-2:]
         if (
@@ -134,24 +155,35 @@ class MatrixErasureCodec(ErasureCodeBase):
         if not want:
             return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
-        bmat = self._tables.get(key, lambda: self._build_decode_bmat(present, want))
-        stacked = jnp.stack([chunks[i] for i in present], axis=-2)
-        out = _apply_bitmatrix(bmat, stacked)
+        vals = [chunks[i] for i in present]
+        if all(
+            isinstance(v, np.ndarray) for v in vals
+        ) and self._host_sized(*vals):
+            from ceph_tpu.gf import gf_apply_bytes_host
+
+            mat = self._host_tables.get(
+                key, lambda: self._build_decode_bytes(present, want)
+            )
+            out = gf_apply_bytes_host(mat, np.stack(vals, axis=-2))
+        else:
+            bmat = self._tables.get(
+                key, lambda: self._build_decode_bmat(present, want)
+            )
+            stacked = jnp.stack(vals, axis=-2)
+            out = _apply_bitmatrix(bmat, stacked)
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, w in enumerate(want):
             result[w] = out[..., idx, :]
         return result
 
-    def _build_decode_bmat(
+    def _build_decode_bytes(
         self, present: list[int], want: list[int]
-    ) -> jax.Array:
-        """Rows producing each wanted shard from the present shards.
-
-        Data shards come from the inverted-submatrix rows; wanted parity
-        shards are re-encoded as G_parity_row @ (decode rows) — the
-        decode-of-data + re-encode-of-parity split of
-        shard_extent_map_t::decode (osd/ECUtil.cc:648-729).
-        """
+    ) -> np.ndarray:
+        """Byte-matrix rows producing each wanted shard from the
+        present shards. Data shards come from the inverted-submatrix
+        rows; wanted parity shards are re-encoded as G_parity_row @
+        (decode rows) — the decode-of-data + re-encode-of-parity split
+        of shard_extent_map_t::decode (osd/ECUtil.cc:648-729)."""
         from ceph_tpu.gf import gf_matmul_np
 
         d = decode_matrix(self.generator, self.k, present)  # [k, len(present)]
@@ -161,7 +193,16 @@ class MatrixErasureCodec(ErasureCodeBase):
                 rows.append(d[w, :])
             else:
                 rows.append(gf_matmul_np(self.generator[w : w + 1, :], d)[0])
-        return jnp.asarray(gf_matrix_to_bitmatrix(np.stack(rows)))
+        return np.stack(rows)
+
+    def _build_decode_bmat(
+        self, present: list[int], want: list[int]
+    ) -> jax.Array:
+        return jnp.asarray(
+            gf_matrix_to_bitmatrix(
+                self._build_decode_bytes(present, want)
+            )
+        )
 
     # -- parity delta (RMW) -------------------------------------------
     def encode_delta(
@@ -180,13 +221,28 @@ class MatrixErasureCodec(ErasureCodeBase):
         one small matmul over just the changed columns.
         """
         cols = sorted(delta)
+        vals = [delta[c] for c in cols]
+        if all(isinstance(v, np.ndarray) for v in vals) and self._host_sized(
+            *vals
+        ):
+            from ceph_tpu.gf import gf_apply_bytes_host
+
+            contrib = gf_apply_bytes_host(
+                self.generator[self.k :, cols], np.stack(vals, axis=-2)
+            )
+            return {
+                pid: np.bitwise_xor(
+                    np.asarray(p), contrib[..., pid - self.k, :]
+                )
+                for pid, p in parity.items()
+            }
         bmat = self._tables.get(
             ("delta", tuple(cols)),
             lambda: jnp.asarray(
                 gf_matrix_to_bitmatrix(self.generator[self.k :, cols])
             ),
         )
-        stacked = jnp.stack([delta[c] for c in cols], axis=-2)
+        stacked = jnp.stack(vals, axis=-2)
         contrib = _apply_bitmatrix(bmat, stacked)
         return {
             pid: xor_bytes(p, contrib[..., pid - self.k, :])

@@ -19,6 +19,7 @@ from .tables import (  # noqa: F401
     gf_div,
     gf_inv,
     gf_pow,
+    gf_apply_bytes_host,
     gf_mul_bytes,
     mul_bitmatrix,
     MUL_BITMATRIX,

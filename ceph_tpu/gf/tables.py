@@ -109,3 +109,38 @@ def mul_bitmatrix(c: int) -> np.ndarray:
 
 # [256, 8, 8] — all multiply-by-constant bit matrices.
 MUL_BITMATRIX = np.stack([mul_bitmatrix(c) for c in range(256)])
+
+
+def gf_apply_bytes_host(mat: np.ndarray, stacked: np.ndarray) -> np.ndarray:
+    """Apply a GF(2^8) byte matrix on the HOST: out[..., r, :] =
+    XOR_c mat[r, c] * stacked[..., c, :].
+
+    The small-op fast path (the reference's ec_encode_data on CPU):
+    device dispatch costs more than the math below ~1 MiB, especially
+    through a remote-device tunnel. Uses the native SIMD region kernel
+    when built, the log/exp tables otherwise — both bit-identical to
+    the device bit-plane path (verified in tests).
+    """
+    from ceph_tpu import native
+
+    mat = np.asarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(stacked, dtype=np.uint8)
+    lead = data.shape[:-2]
+    flat = data.reshape((-1,) + data.shape[-2:])
+    b, c_count, n = flat.shape
+    r_count = mat.shape[0]
+    if native.available():
+        # one native call per batch item (the C kernel runs the whole
+        # mat x data application; per-call ctypes overhead would
+        # otherwise dominate exactly the small ops this path serves)
+        out = np.stack(
+            [native.gf_matrix_encode(mat, flat[i]) for i in range(b)]
+        )
+    else:
+        out = np.zeros((b, r_count, n), dtype=np.uint8)
+        for r in range(r_count):
+            for c in range(c_count):
+                g = int(mat[r, c])
+                if g:
+                    out[:, r, :] ^= gf_mul_bytes(g, flat[:, c, :])
+    return out.reshape(lead + (r_count, n))

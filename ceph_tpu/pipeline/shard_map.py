@@ -195,12 +195,10 @@ class ShardExtentMap:
 
     @staticmethod
     def _dispatch_encode(codec, data: np.ndarray) -> np.ndarray:
-        """[k, L] host -> [m, L] host through the codec's device path."""
-        import jax.numpy as jnp
-
+        """[k, L] host -> [m, L] host through the codec's dispatch."""
         k = data.shape[0]
         parity = codec.encode_chunks(
-            {i: jnp.asarray(data[i]) for i in range(k)}
+            {i: np.asarray(data[i]) for i in range(k)}
         )
         return np.stack(
             [np.asarray(parity[k + j]) for j in range(len(parity))]
@@ -211,8 +209,6 @@ class ShardExtentMap:
         present here, delta = old XOR new; parity' = parity XOR
         sum_i G[:,i] * delta_i. ``old_map`` must hold the old data AND
         old parity over this map's window."""
-        import jax.numpy as jnp
-
         k, m = self.sinfo.k, self.sinfo.m
         lo, hi = self._slice_window()
         if hi <= lo:
@@ -233,15 +229,15 @@ class ShardExtentMap:
                 e = min(end, hi)
                 if s < e:
                     new[s - lo : e - lo] = self.get(shard, s, e - s)
-            deltas[raw] = jnp.asarray(
-                np.asarray(
-                    codec.encode_delta(jnp.asarray(old), jnp.asarray(new))
-                )
+            # delta is plain GF addition: XOR on the host (a device
+            # round-trip per shard would serialize k tunnel RTTs)
+            deltas[raw] = np.bitwise_xor(
+                np.asarray(old), np.asarray(new)
             )
         if not deltas:
             return
         parity_in = {
-            k + j: jnp.asarray(
+            k + j: np.asarray(
                 old_map.get(self.sinfo.get_shard(k + j), lo, hi - lo)
             )
             for j in range(m)
@@ -258,8 +254,6 @@ class ShardExtentMap:
         survivors; wanted parity shards re-encode from (possibly just-
         decoded) data. Buffers are zero-padded to the common window and
         trimmed back to each shard's size within ``object_size``."""
-        import jax.numpy as jnp
-
         sinfo = self.sinfo
         missing_raw = sorted(
             sinfo.get_raw_shard(s) for s in want if s not in self._bufs
@@ -285,7 +279,7 @@ class ShardExtentMap:
         present_raw.sort()
         n_chunks = (hi - lo) // cs
         chunks = {
-            raw: jnp.asarray(
+            raw: np.asarray(
                 self.get(sinfo.get_shard(raw), lo, hi - lo).reshape(
                     n_chunks, cs
                 )

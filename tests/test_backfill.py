@@ -152,15 +152,25 @@ def test_backfill_gc_removes_stale_copies(cluster):
     mon.osd_out(victim)
     wait_no_pg_temp(mon)
     assert io.read("obj") == payload(3_000)
-    # gc ran against reachable ex-members: stale shard copies dropped
-    # from every live OSD that is no longer a holder for its key
+    # gc runs AFTER the temp clears — poll for it: stale shard copies
+    # dropped from every live OSD no longer a holder for its key
     target = mon.osdmap.object_to_acting("ecpool", "obj")
-    for i, osd in enumerate(acting0):
-        if osd == victim:
-            continue  # down: unreachable for gc, stale copy inert
-        if i < len(target) and target[i] == osd:
-            continue  # still the holder of position i
-        assert not daemons[osd].store.exists(shard_key(loc, i))
+
+    def leftover():
+        out = []
+        for i, osd in enumerate(acting0):
+            if osd == victim:
+                continue  # down: unreachable for gc, stale copy inert
+            if i < len(target) and target[i] == osd:
+                continue  # still the holder of position i
+            if daemons[osd].store.exists(shard_key(loc, i)):
+                out.append((i, osd))
+        return out
+
+    end = time.monotonic() + 15
+    while leftover() and time.monotonic() < end:
+        time.sleep(0.05)
+    assert not leftover()
 
 
 def test_write_during_pg_temp_window_not_lost(cluster):
