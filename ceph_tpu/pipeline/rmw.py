@@ -219,7 +219,14 @@ class ShardBackend:
             elif ec_inject.test_read_error1(oid, shard):
                 cb(shard, ShardReadError(shard, oid, kind="missing"))
             else:
-                cb(shard, self.read_shard(shard, oid, extents))
+                try:
+                    cb(shard, self.read_shard(shard, oid, extents))
+                except Exception:
+                    # store-level EIO (e.g. a BlockStore csum failure)
+                    # answers as a shard error — the reference's
+                    # handle_sub_read returns -EIO, it never tears the
+                    # connection down (ECBackend.cc:998)
+                    cb(shard, ShardReadError(shard, oid, kind="eio"))
 
         if self.defer_reads:
             self.deferred_reads.append((shard, run))

@@ -11,5 +11,14 @@ plays the same role for the TPU pipeline tests.
 from .transaction import Op, OpKind, Transaction
 from .memstore import MemStore
 from .filestore import FileStore
+from .blockstore import BlockStore, CsumError
 
-__all__ = ["FileStore", "MemStore", "Op", "OpKind", "Transaction"]
+__all__ = [
+    "BlockStore",
+    "CsumError",
+    "FileStore",
+    "MemStore",
+    "Op",
+    "OpKind",
+    "Transaction",
+]

@@ -1,0 +1,262 @@
+"""Allocator family + BlockStore (BlueStore-analog) semantics:
+conservation/no-overlap model checks, csum verification on read,
+WAL recovery, checkpointing, and space reclamation."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.store import BlockStore, CsumError, Transaction
+from ceph_tpu.store.allocator import (
+    ALLOCATORS,
+    AllocError,
+    BitmapAllocator,
+    BtreeAllocator,
+    HybridAllocator,
+)
+
+
+# -- allocators ----------------------------------------------------------
+
+
+@pytest.fixture(params=sorted(ALLOCATORS))
+def alloc(request):
+    a = ALLOCATORS[request.param](alloc_unit=4096)
+    a.init_add_free(0, 1 << 22)  # 4 MiB
+    return a
+
+
+def test_alloc_free_roundtrip(alloc):
+    total = alloc.get_free()
+    got = alloc.allocate(10_000)
+    assert sum(ln for _, ln in got) >= 10_000
+    assert alloc.get_free() == total - sum(ln for _, ln in got)
+    alloc.release(got)
+    assert alloc.get_free() == total
+
+
+def test_allocations_never_overlap(alloc):
+    held = []
+    for _ in range(50):
+        held.extend(alloc.allocate(8192))
+    spans = sorted(held)
+    for (o1, l1), (o2, _l2) in zip(spans, spans[1:]):
+        assert o1 + l1 <= o2
+
+
+def test_enospc(alloc):
+    with pytest.raises(AllocError):
+        alloc.allocate((1 << 22) + 4096)
+    alloc.allocate(1 << 22)  # exactly everything works
+    with pytest.raises(AllocError):
+        alloc.allocate(4096)
+
+
+def test_double_free_detected(alloc):
+    got = alloc.allocate(4096)
+    alloc.release(got)
+    with pytest.raises(ValueError):
+        alloc.release(got)
+
+
+def test_btree_coalesces_frees():
+    a = BtreeAllocator(4096)
+    a.init_add_free(0, 1 << 20)
+    chunks = [a.allocate(4096)[0] for _ in range(256)]
+    assert a.get_free() == 0
+    for c in chunks:  # release in order: must merge back to ONE extent
+        a.release([c])
+    assert a.free_extents() == [(0, 1 << 20)]
+
+
+def test_fragmented_allocation_gathers(alloc):
+    # checkerboard the space, then ask for more than any single hole
+    held = [alloc.allocate(4096)[0] for _ in range(512)]
+    for c in held[::2]:
+        alloc.release([c])
+    got = alloc.allocate(3 * 4096)  # needs gathering across holes
+    assert sum(ln for _, ln in got) >= 3 * 4096
+
+
+def test_model_checked_random_alloc(alloc):
+    """Random alloc/release vs a set model: conservation + no overlap
+    at every step."""
+    rng = np.random.default_rng(7)
+    total = alloc.get_free()
+    held: list[tuple[int, int]] = []
+    for _ in range(300):
+        if held and rng.random() < 0.45:
+            i = int(rng.integers(0, len(held)))
+            alloc.release([held.pop(i)])
+        else:
+            want = int(rng.integers(1, 10)) * 4096
+            try:
+                got = alloc.allocate(want)
+            except AllocError:
+                continue
+            held.extend(got)
+        assert alloc.get_free() + sum(ln for _, ln in held) == total
+        spans = sorted(held)
+        for (o1, l1), (o2, _), in zip(spans, spans[1:]):
+            assert o1 + l1 <= o2
+
+
+def test_hybrid_spills_to_bitmap():
+    a = HybridAllocator(4096, max_extents=16)
+    a.init_add_free(0, 1 << 20)
+    held = [a.allocate(4096)[0] for _ in range(200)]
+    for c in held[::2]:  # 100 isolated free fragments > max_extents
+        a.release([c])
+    assert a.bitmap is not None  # spilled
+    got = a.allocate(4096)  # still serves from either side
+    assert got
+
+
+# -- blockstore ----------------------------------------------------------
+
+
+def test_blockstore_persists_across_reopen(tmp_path):
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 22)
+    blob = np.random.default_rng(0).integers(
+        0, 256, 20_000, dtype=np.uint8
+    ).tobytes()
+    st.queue_transactions(
+        Transaction().write("o", 0, blob).setattr("o", "a", b"v")
+    )
+    st.close()
+    st2 = BlockStore(root, size=1 << 22)
+    assert st2.read("o") == blob
+    assert st2.getattr("o", "a") == b"v"
+
+
+def test_blockstore_wal_recovery_without_checkpoint(tmp_path):
+    """Metadata committed only to the WAL (no close/checkpoint) must
+    survive a crash — replay from the last checkpoint + WAL tail."""
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 22)
+    st.queue_transactions(Transaction().write("o", 0, b"v1"))
+    st.queue_transactions(Transaction().write("o", 0, b"v2"))
+    # simulate crash: no close(); reopen reads ckpt (absent) + WAL
+    st2 = BlockStore(root, size=1 << 22)
+    assert st2.read("o") == b"v2"
+    assert st2.committed_seq == st.committed_seq
+
+
+def test_blockstore_detects_bit_rot(tmp_path):
+    """Flip a byte on the device behind the store's back: the read
+    must fail with a checksum error, never return wrong bytes
+    (BlueStore::_verify_csum)."""
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 22)
+    blob = b"A" * 10_000
+    st.queue_transactions(Transaction().write("o", 0, blob))
+    dev_off = next(iter(st._objects["o"].blobs.values())).offset
+    with open(os.path.join(root, "block"), "r+b") as f:
+        f.seek(dev_off + 100)
+        f.write(b"\xff")
+    with pytest.raises(CsumError):
+        st.read("o")
+
+
+def test_blockstore_reclaims_space(tmp_path):
+    """Remove/overwrite releases blocks: the store never leaks the
+    device (write/delete cycles far exceeding device capacity)."""
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 20)  # 1 MiB device
+    blob = b"x" * 200_000
+    for i in range(20):  # 4 MiB total traffic through a 1 MiB device
+        st.queue_transactions(Transaction().write("o", 0, blob))
+        st.queue_transactions(Transaction().remove("o"))
+    free0 = st.allocator.get_free()
+    assert free0 == st.device_size
+
+
+def test_blockstore_cow_overwrite_keeps_old_until_commit(tmp_path):
+    """Overwrites allocate fresh blocks (COW): the new data lands at
+    different device offsets than the old."""
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 22)
+    st.queue_transactions(Transaction().write("o", 0, b"a" * 8192))
+    before = {b.offset for b in st._objects["o"].blobs.values()}
+    st.queue_transactions(Transaction().write("o", 0, b"b" * 8192))
+    after = {b.offset for b in st._objects["o"].blobs.values()}
+    assert before.isdisjoint(after)
+    assert st.read("o") == b"b" * 8192
+
+
+def test_blockstore_checkpoint_absorbs_wal(tmp_path):
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 22, checkpoint_every=4)
+    for i in range(6):  # crosses the checkpoint threshold
+        st.queue_transactions(Transaction().write(f"o{i}", 0, b"z" * 100))
+    assert os.path.exists(os.path.join(root, "meta.ckpt"))
+    st2 = BlockStore(root, size=1 << 22)
+    assert st2.list_objects() == [f"o{i}" for i in range(6)]
+    for i in range(6):
+        assert st2.read(f"o{i}") == b"z" * 100
+
+
+def test_truncate_never_launders_corruption(tmp_path):
+    """Truncate's straddling-blob trim re-checksums old bytes: it must
+    VERIFY them first, or on-device corruption would be laundered into
+    a fresh blob with valid csums."""
+    root = str(tmp_path / "bs")
+    st = BlockStore(root, size=1 << 22)
+    st.queue_transactions(Transaction().write("o", 0, b"A" * 8192))
+    dev_off = next(iter(st._objects["o"].blobs.values())).offset
+    with open(os.path.join(root, "block"), "r+b") as f:
+        f.seek(dev_off + 100)
+        f.write(b"\xff")
+    with pytest.raises(CsumError):
+        st.queue_transactions(Transaction().truncate("o", 5000))
+
+
+def test_hybrid_grows_bitmap_and_gathers_across_pools():
+    """Spills beyond the first-spill device end must not crash, and an
+    allocation covered only by btree+bitmap TOGETHER must succeed."""
+    a = HybridAllocator(4096, max_extents=8)
+    # incremental init with ascending gaps (the freelist-rebuild shape)
+    for i in range(40):
+        a.init_add_free(i * 3 * 4096, 4096)  # fragmented low range
+    a.init_add_free(40 * 3 * 4096, 64 * 4096)  # later, higher range
+    assert a.bitmap is not None
+    total = a.get_free()
+    got = a.allocate(total)  # everything, across both pools
+    assert sum(ln for _, ln in got) == total
+    assert a.get_free() == 0
+
+
+def test_blockstore_runs_pipeline(tmp_path):
+    """BlockStore drops in as an OSD shard store: the EC write/read
+    round-trip runs over it unchanged."""
+    from ceph_tpu.codecs import registry
+    from ceph_tpu.pipeline.read import ReadPipeline
+    from ceph_tpu.pipeline.rmw import RMWPipeline, ShardBackend
+    from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
+
+    k, m = 3, 2
+    sinfo = StripeInfo(k, m, k * PAGE_SIZE)
+    codec = registry.factory(
+        "jerasure", {"technique": "reed_sol_van", "k": str(k), "m": str(m)}
+    )
+    stores = {
+        s: BlockStore(str(tmp_path / f"osd{s}"), size=1 << 22)
+        for s in range(k + m)
+    }
+    backend = ShardBackend(stores)
+    rmw = RMWPipeline(sinfo, codec, backend)
+    data = np.random.default_rng(5).integers(
+        0, 256, 30_000, dtype=np.uint8
+    ).tobytes()
+    done = []
+    rmw.submit("obj", 0, data, on_commit=lambda op: done.append(op))
+    assert done and done[0].error is None
+    backend.down_shards = {0, 4}
+    reads = ReadPipeline(sinfo, codec, backend, rmw.object_size)
+    out = []
+    reads.submit("obj", 0, len(data), on_complete=lambda op: out.append(op))
+    assert out[0].error is None
+    assert out[0].data == data

@@ -9,14 +9,16 @@ import pytest
 
 from ceph_tpu.checksum.host import crc32c
 from ceph_tpu.pipeline.hashinfo import HashInfo
-from ceph_tpu.store import FileStore, MemStore, Transaction
+from ceph_tpu.store import BlockStore, FileStore, MemStore, Transaction
 
 
-@pytest.fixture(params=["memstore", "filestore"])
+@pytest.fixture(params=["memstore", "filestore", "blockstore"])
 def st(request, tmp_path):
     if request.param == "memstore":
         return MemStore()
-    return FileStore(str(tmp_path / "fs"))
+    if request.param == "filestore":
+        return FileStore(str(tmp_path / "fs"))
+    return BlockStore(str(tmp_path / "bs"), size=1 << 22)
 
 
 def journal_append(path, payload, crc=None):
