@@ -31,7 +31,37 @@ import time
 from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
 from ceph_tpu.cluster.mon_store import MonStore
 from ceph_tpu.cluster.osd_daemon import SHARD_NONE, split_loc, split_shard_key
-from ceph_tpu.store import FileStore
+from ceph_tpu.store import BlockStore, FileStore
+
+
+def _open_store(osd_dir: str):
+    """Open an existing OSD dir with the backend it was created with
+    (the ``backend`` marker; device-file detection as fallback)."""
+    marker = os.path.join(osd_dir, "backend")
+    if os.path.exists(marker):
+        kind = open(marker).read().strip()
+    else:
+        kind = (
+            "block" if os.path.exists(os.path.join(osd_dir, "block"))
+            else "file"
+        )
+    return BlockStore(osd_dir) if kind == "block" else FileStore(osd_dir)
+
+
+def _cluster_backend(root: str) -> str | None:
+    """The backend existing OSDs use (None if no OSDs yet) — a
+    scale-up without --store follows the cluster, not the default."""
+    for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
+        if name.startswith("osd."):
+            marker = os.path.join(root, name, "backend")
+            if os.path.exists(marker):
+                return open(marker).read().strip()
+            return (
+                "block"
+                if os.path.exists(os.path.join(root, name, "block"))
+                else "file"
+            )
+    return None
 
 
 class Cluster:
@@ -52,7 +82,7 @@ class Cluster:
             osd = int(name.split(".", 1)[1])
             if os.path.exists(os.path.join(root, name, "stopped")):
                 continue  # operator stopped it (osd-down marker)
-            store = FileStore(os.path.join(root, name))
+            store = _open_store(os.path.join(root, name))
             d = OSDDaemon(osd, self.mon, store=store)
             d.start()
             self.daemons[osd] = d
@@ -61,9 +91,13 @@ class Cluster:
             self.mon.osd_down(osd)
         self.client = RadosClient(self.mon, backoff=0.02)
 
-    def add_osd(self, osd: int, zone: str = "") -> None:
+    def add_osd(self, osd: int, zone: str = "", backend: str | None = None) -> None:
         self.mon.osd_crush_add(osd, zone=zone)
-        store = FileStore(os.path.join(self.root, f"osd.{osd}"))
+        backend = backend or _cluster_backend(self.root) or "file"
+        path = os.path.join(self.root, f"osd.{osd}")
+        store = BlockStore(path) if backend == "block" else FileStore(path)
+        with open(os.path.join(path, "backend"), "w") as f:
+            f.write(backend)
         d = OSDDaemon(osd, self.mon, store=store)
         d.start()
         self.daemons[osd] = d
@@ -79,6 +113,8 @@ class Cluster:
         self.client.shutdown()
         for d in self.daemons.values():
             d.stop()
+            if hasattr(d.store, "close"):
+                d.store.close()
 
     # -- object listing (the rados ls role: union of shard scans) ------
     def list_objects(self, pool: str) -> list[str]:
@@ -100,7 +136,9 @@ def cmd_vstart(cl: Cluster, args) -> int:
     existing = set(cl.daemons)
     for i in range(args.osds):
         if i not in existing:
-            cl.add_osd(i, zone=f"z{i % max(args.zones, 1)}")
+            cl.add_osd(
+                i, zone=f"z{i % max(args.zones, 1)}", backend=args.store
+            )
     print(f"cluster up: {len(cl.daemons)} osds, epoch "
           f"{cl.mon.osdmap.epoch}, dir {cl.root}")
     return 0
@@ -204,6 +242,8 @@ def cmd_osd_down(cl: Cluster, args) -> int:
     d = cl.daemons.pop(args.osd, None)
     if d is not None:
         d.stop()
+        if hasattr(d.store, "close"):
+            d.store.close()  # final checkpoint for BlockStore
     open(os.path.join(cl.root, f"osd.{args.osd}", "stopped"), "w").close()
     cl.mon.osd_down(args.osd)
     print(f"osd.{args.osd} stopped + marked down")
@@ -215,7 +255,7 @@ def cmd_osd_up(cl: Cluster, args) -> int:
     if os.path.exists(marker):
         os.unlink(marker)
     if args.osd not in cl.daemons:
-        store = FileStore(os.path.join(cl.root, f"osd.{args.osd}"))
+        store = _open_store(os.path.join(cl.root, f"osd.{args.osd}"))
         d = OSDDaemon(args.osd, cl.mon, store=store)
         d.start()
         cl.daemons[args.osd] = d
@@ -295,6 +335,12 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("vstart", help="create/boot a dev cluster")
     s.add_argument("--osds", type=int, default=6)
     s.add_argument("--zones", type=int, default=3)
+    s.add_argument(
+        "--store", choices=("file", "block"), default=None,
+        help="OSD backend for NEW osds: FileStore tree or BlockStore "
+             "raw device (default: whatever the cluster already uses, "
+             "else file)",
+    )
     s.set_defaults(fn=cmd_vstart)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)

@@ -359,6 +359,11 @@ class OSDDaemon:
             self._sched_cv.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=2.0)
+        # backfill threads write to the store: they must land before a
+        # caller closes it
+        for t in list(self._backfills.values()):
+            if t.is_alive():
+                t.join(timeout=5.0)
         if self._tick_stop is not None:
             self._tick_stop.set()
             self._tick_thread.join(timeout=2.0)
