@@ -297,6 +297,30 @@ def cmd_scrub(cl: Cluster, args) -> int:
     return 1 if (bad and not args.repair) else 0
 
 
+def cmd_perf(cl: Cluster, args) -> int:
+    """The `ceph daemon ... perf dump` role: every pipeline's counters
+    (all daemons share this process's collection)."""
+    from ceph_tpu.utils import perf_collection
+
+    def active(v) -> bool:
+        if isinstance(v, (int, float)):
+            return bool(v)
+        if isinstance(v, dict):
+            if "counts" in v:  # histogram: samples, not bucket edges
+                return any(v["counts"])
+            return any(active(x) for x in v.values())
+        return False
+
+    snap = perf_collection.dump()
+    for logger in sorted(snap):
+        if args.grep and args.grep not in logger:
+            continue
+        counters = {k: v for k, v in snap[logger].items() if active(v)}
+        if counters:
+            print(json.dumps({logger: counters}))
+    return 0
+
+
 def cmd_bench(cl: Cluster, args) -> int:
     """The `rados bench` role: time writes then reads."""
     import numpy as np
@@ -384,6 +408,10 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("scrub")
     s.add_argument("--repair", action="store_true")
     s.set_defaults(fn=cmd_scrub)
+
+    s = sub.add_parser("perf", help="dump perf counters (perf dump)")
+    s.add_argument("--grep", default="", help="substring filter")
+    s.set_defaults(fn=cmd_perf)
 
     s = sub.add_parser("bench")
     s.add_argument("pool")

@@ -57,6 +57,7 @@ class Objecter:
         self._tids = itertools.count(1)
         self._lock = threading.Lock()
         self._waiting: dict[int, dict] = {}  # tid -> {event, reply}
+        self._aio_executor = None
         #: ops resent so far (visible to tests: the resend contract)
         self.resends = 0
 
@@ -141,8 +142,84 @@ class Objecter:
             f"attempts ({last})"
         )
 
+    def aio_submit(
+        self,
+        pool: str,
+        oid: str,
+        op: str,
+        offset: int = 0,
+        length: int = 0,
+        data: bytes = b"",
+        on_complete=None,
+    ) -> Completion:
+        """Asynchronous submit (rados_aio_*): the full retry/resend
+        loop runs on a worker thread; the returned Completion fires
+        when the op terminally succeeds or fails."""
+        c = Completion()
+
+        def run() -> None:
+            try:
+                reply, err = self.submit(
+                    pool, oid, op, offset, length, data
+                ), None
+            except Exception as e:
+                reply, err = None, e
+            c._resolve(reply, err, on_complete)
+
+        self._aio_pool().submit(run)
+        return c
+
+    def _aio_pool(self):
+        """Shared bounded worker pool for aio ops (one thread per op
+        would be unbounded through retry/backoff loops)."""
+        with self._lock:
+            if self._aio_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._aio_executor = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="objecter-aio"
+                )
+            return self._aio_executor
+
     def shutdown(self) -> None:
+        if self._aio_executor is not None:
+            self._aio_executor.shutdown(wait=False)
         self.messenger.shutdown()
+
+
+class Completion:
+    """Async-op handle (rados_completion_t): poll ``is_complete``,
+    block in ``wait_for_complete``, or get a callback. The callback
+    runs BEFORE waiters wake (and its exceptions are isolated), so
+    side effects it makes are visible to anyone past
+    ``wait_for_complete``."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.reply: OSDOpReply | None = None
+        self.error: Exception | None = None
+
+    def _resolve(self, reply, error, on_complete) -> None:
+        self.reply = reply
+        self.error = error
+        if on_complete is not None:
+            try:
+                on_complete(self)
+            except Exception:
+                pass  # a callback bug must not change the op's outcome
+        self._event.set()
+
+    def is_complete(self) -> bool:
+        return self._event.is_set()
+
+    def wait_for_complete(self, timeout: float | None = None):
+        """Block until done; returns the result (or raises the op's
+        error) like get() on a future."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("aio op incomplete")
+        if self.error is not None:
+            raise self.error
+        return self.reply
 
 
 class IoCtx:
@@ -175,6 +252,28 @@ class IoCtx:
 
     def remove(self, oid: str) -> None:
         self.objecter.submit(self.pool, oid, "remove")
+
+    # -- async surface (rados_aio_write/read/remove) -------------------
+    def aio_write(
+        self, oid: str, data: bytes, offset: int = 0, on_complete=None
+    ) -> Completion:
+        return self.objecter.aio_submit(
+            self.pool, oid, "write", offset=offset, data=bytes(data),
+            on_complete=on_complete,
+        )
+
+    def aio_read(
+        self, oid: str, offset: int = 0, length: int = 0, on_complete=None
+    ) -> Completion:
+        return self.objecter.aio_submit(
+            self.pool, oid, "read", offset=offset, length=length,
+            on_complete=on_complete,
+        )
+
+    def aio_remove(self, oid: str, on_complete=None) -> Completion:
+        return self.objecter.aio_submit(
+            self.pool, oid, "remove", on_complete=on_complete
+        )
 
 
 class RadosClient:

@@ -217,3 +217,26 @@ def test_peer_failure_reports_reach_monitor(cluster):
         daemons[reporter].peers.down_shards.add(victim)
         daemons[reporter].report_down_peers()
     assert not mon.osdmap.is_up(victim)
+
+
+def test_aio_surface(cluster):
+    """librados aio contract: parallel completions, callbacks, errors
+    surfaced through wait_for_complete."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    blobs = {f"a{i}": payload(3_000, seed=i) for i in range(6)}
+    comps = [io.aio_write(oid, b) for oid, b in blobs.items()]
+    for c in comps:
+        c.wait_for_complete(timeout=30)
+    fired = []
+    reads = [
+        io.aio_read(oid, on_complete=lambda c, o=oid: fired.append(o))
+        for oid in blobs
+    ]
+    for oid, c in zip(blobs, reads):
+        assert c.wait_for_complete(timeout=30).data == blobs[oid]
+    assert sorted(fired) == sorted(blobs)
+    bad = io.aio_read("ghost")
+    with pytest.raises(FileNotFoundError):
+        bad.wait_for_complete(timeout=30)
+    assert bad.is_complete()
