@@ -228,8 +228,16 @@ class _PG:
                  raw: list[int], acting: list[int]) -> None:
         spec = daemon.osdmap.pools[pool]
         profile = dict(daemon.osdmap.profiles[spec.profile_name])
+        self.pool = pool
+        self.pgid = pg
         self.raw = list(raw)        # CRUSH membership (rebalance id)
         self.acting = list(acting)  # raw with down members as holes
+        #: positions that were ALREADY holes when this instance was
+        #: created: the op log cannot vouch for their gap — a member
+        #: returning to one needs a full-shard refresh, not log replay
+        self.born_holes: set[int] = {
+            i for i, o in enumerate(acting) if o == SHARD_NONE
+        }
         self.backfilling = False    # pg_temp installed, data moving
         self.backfill_dirty: set[str] = set()  # written mid-backfill
         self.backfill_done = False  # moved; drop on next map change
@@ -443,10 +451,15 @@ class OSDDaemon:
                 pg.backend.recovering.update(healed)
                 if healed:
                     to_recover.append((pg, healed))
-        # drive recovery OUTSIDE the pg lock (it does IO + drains)
+        # drive recovery OUTSIDE the pg lock on worker threads: a
+        # born-hole refresh is O(objects in PG) of network IO, and this
+        # callback runs on the monitor's notify path
         for pg, healed in to_recover:
             for shard in healed:
-                self._catch_up_shard(pg, shard)
+                threading.Thread(
+                    target=self._catch_up_shard, args=(pg, shard),
+                    daemon=True,
+                ).start()
         for pool, pgid, pg in maybe_backfill:
             if self._request_pg_temp(pool, pgid, pg):
                 self._start_backfill(pool, pgid, pg)
@@ -473,9 +486,45 @@ class OSDDaemon:
     def _catch_up_shard(self, pg: _PG, shard: int) -> None:
         """Replay the op log onto a returned member until it is clean
         (writes racing the replay append new dirty entries — loop),
-        then admit it to the acting set. On failure the position
-        reverts to a hole; the next map change retries."""
+        then admit it to the acting set. A member whose absence
+        PREDATES this PG instance gets a full-shard refresh first —
+        the log holds no record of what it missed, so every object's
+        shard is rebuilt from the survivors (the authoritative-log
+        peering decision collapsed to 'refresh when the log cannot
+        vouch'). On failure the position reverts to a hole; the next
+        map change retries."""
         try:
+            if shard in pg.born_holes:
+                spec = self.osdmap.pools[pg.pool]
+                target_osd = pg.acting[shard]
+                # the returning member's own (stale) reports must not
+                # vouch for objects: only OTHER survivors count
+                hints = self._backfill_scan(
+                    pg.pool, pg.pgid, spec, pg, exclude=target_osd
+                )
+                for loc in sorted(hints):
+                    self.admit("recovery")
+                    size = self._object_size(pg, loc)
+                    known = bool(size) or self._have_object(pg, loc)
+                    size_hint = None
+                    if not known and hints[loc] > 0:
+                        # a PEER holds it even though my store doesn't
+                        # (my own copy is incomplete): recover, never
+                        # delete a surviving good shard. The hint goes
+                        # to recovery directly — priming the live
+                        # pipeline with it could resurrect a size for
+                        # an object a racing remove just dropped.
+                        size_hint = hints[loc]
+                        known = True
+                    if not known:
+                        # gone while the member was away: propagate
+                        # the delete (its stale copy fed the scan)
+                        self._push_delete(target_osd, loc, shard)
+                        continue
+                    pg.recovery.recover_object(
+                        loc, {shard}, size=size_hint
+                    )
+                pg.born_holes.discard(shard)
             for _ in range(8):
                 self.admit("recovery")
                 pg.recovery.recover_from_log(pg.pglog, shard)
@@ -783,7 +832,8 @@ class OSDDaemon:
             pg.backfilling = False
 
     def _backfill_scan(
-        self, pool: str, pgid: int, spec, pg: _PG
+        self, pool: str, pgid: int, spec, pg: _PG,
+        exclude: int | None = None,
     ) -> dict[str, int]:
         """Union of the PG's oids across my store and every reachable
         member of both layouts (old holders + targets with partial
@@ -794,7 +844,7 @@ class OSDDaemon:
             oids[loc] = -1
         peers = (set(pg.acting) | set(
             self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
-        )) - {SHARD_NONE, self.osd_id}
+        )) - {SHARD_NONE, self.osd_id, exclude}
         for osd in sorted(peers):
             if osd not in self.peers.avail_shards():
                 continue
@@ -835,12 +885,7 @@ class OSDDaemon:
         if not exists:
             # removed mid-backfill: propagate the delete to targets
             for i in moves:
-                self._push_shard_txn(
-                    target[i],
-                    Transaction().touch(shard_key(oid, i)).remove(
-                        shard_key(oid, i)
-                    ),
-                )
+                self._push_delete(target[i], oid, i)
             return
         shard_len = pg.sinfo.object_size_to_shard_size(size, 0)
         want = {i: ExtentSet([(0, shard_len)]) for i in moves}
@@ -876,6 +921,12 @@ class OSDDaemon:
             txn.setattr(key, OI_KEY, str(size).encode())
             txn.setattr(key, SI_KEY, str(i).encode())
             self._push_shard_txn(target[i], txn)
+
+    def _push_delete(self, osd: int, loc: str, shard: int) -> None:
+        """Propagate a whole-object delete to one shard holder
+        (touch+remove: no-op if the key never existed)."""
+        key = shard_key(loc, shard)
+        self._push_shard_txn(osd, Transaction().touch(key).remove(key))
 
     def _push_shard_txn(self, osd: int, txn) -> None:
         """Synchronous push to one osd (local or peer)."""

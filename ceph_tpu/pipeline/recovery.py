@@ -65,6 +65,8 @@ class RecoveryOp:
         self.error: Exception | None = None
         # Optional per-shard extent restriction (delta recovery).
         self.extent_override: dict[int, ExtentSet] | None = None
+        # Optional object-size override (peer-reported size).
+        self.size_override: int | None = None
 
 
 class RecoveryBackend:
@@ -121,16 +123,20 @@ class RecoveryBackend:
         oid: str,
         missing: set[int],
         extents: "dict[int, ExtentSet] | None" = None,
+        size: int | None = None,
     ) -> RecoveryOp:
         """Run the FSM to completion. Backends with a ``drain_until``
         event loop (the networked one) are drained between states.
         ``extents`` restricts the rebuild per shard — the log-driven
-        delta-recovery path (see ``recover_from_log``)."""
+        delta-recovery path (see ``recover_from_log``). ``size``
+        overrides size_fn when the caller knows the object size from a
+        source the local state doesn't reflect (a peer's report)."""
         from ceph_tpu.utils import tracer
 
         drain = getattr(self.backend, "drain_until", None)
         op = self.open_recovery_op(oid, missing)
         op.extent_override = extents
+        op.size_override = size
         with tracer.span("ec_recover", oid=oid, missing=sorted(missing)):
             while op.state is not RecoveryState.COMPLETE:
                 before = op.state
@@ -154,8 +160,14 @@ class RecoveryBackend:
         self.perf.inc("recovered_bytes", op.recovered_bytes)
         return op
 
+    def _op_size(self, op: RecoveryOp) -> int:
+        return (
+            op.size_override if op.size_override is not None
+            else self.size_fn(op.oid)
+        )
+
     def _start_reads(self, op: RecoveryOp) -> None:
-        size = self.size_fn(op.oid)
+        size = self._op_size(op)
         op.want = {}
         for shard in op.missing:
             ssize = self.sinfo.object_size_to_exact_shard_size(size, shard)
@@ -235,7 +247,7 @@ class RecoveryBackend:
                 op.read_bytes += len(buf)
 
     def _start_writes(self, op: RecoveryOp) -> None:
-        size = self.size_fn(op.oid)
+        size = self._op_size(op)
         try:
             reconstruct_shards(
                 self.sinfo,

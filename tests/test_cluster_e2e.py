@@ -240,3 +240,70 @@ def test_aio_surface(cluster):
     with pytest.raises(FileNotFoundError):
         bad.wait_for_complete(timeout=30)
     assert bad.is_complete()
+
+
+def test_log_blind_return_gets_full_refresh(cluster):
+    """A member that returns to a PG whose instance was REBUILT while
+    it was gone (primary failover) missed writes the new log never
+    saw: it must be fully refreshed from survivors before serving —
+    otherwise decode would mix its stale shard into reads."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = payload(9_000)
+    io.write("obj", data)
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    primary, member = acting[0], acting[2]
+    mon.osd_down(member)      # member gone (store keeps stale bytes)
+    daemons[primary].stop()   # primary dies: PG rebuilt elsewhere,
+    mon.osd_down(primary)     # born with member's slot a hole
+    data2 = payload(9_000, seed=9)
+    io.write("obj", data2)    # the new log never saw member's gap
+    mon.osd_boot(member, daemons[member].addr)  # full refresh path
+    # force reads through the refreshed member: down enough others
+    # that decode MUST use its shard
+    others = [
+        o for o in mon.osdmap.object_to_acting("ecpool", "obj")
+        if o not in (member, -1)
+    ]
+    # leave exactly k=3 live members INCLUDING the refreshed one
+    for o in others[2:]:
+        daemons[o].stop()
+        mon.osd_down(o)
+    # the refresh runs on a worker thread: poll until it lands
+    import time
+
+    end = time.monotonic() + 20
+    while True:
+        try:
+            assert io.read("obj") == data2
+            break
+        except (IOError, Exception) as e:
+            if isinstance(e, AssertionError) or time.monotonic() > end:
+                raise
+            time.sleep(0.1)
+
+
+def test_object_deleted_during_gap_not_resurrected(cluster):
+    """An object removed while a log-blind member was away must not be
+    resurrected by its stale copy when the member returns."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("obj", payload(4_000))
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    primary, member = acting[0], acting[1]
+    mon.osd_down(member)
+    daemons[primary].stop()
+    mon.osd_down(primary)
+    io.remove("obj")                      # removed during the gap
+    mon.osd_boot(member, daemons[member].addr)
+    import time
+
+    end = time.monotonic() + 10
+    loc_keys = lambda: [
+        k for k in daemons[member].store.list_objects() if "obj" in k
+    ]
+    while loc_keys() and time.monotonic() < end:
+        time.sleep(0.05)
+    assert not loc_keys()                 # stale copy purged
+    with pytest.raises(FileNotFoundError):
+        io.stat("obj")
