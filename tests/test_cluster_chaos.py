@@ -5,6 +5,8 @@ under live client IO, model-checked contents, scrub-repair
 convergence at the end."""
 
 import numpy as np
+import pytest
+
 from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
 
 
@@ -12,8 +14,9 @@ K, M = 3, 2
 N_OSD = 6
 
 
-def test_thrash_kill_revive_under_io():
-    rng = np.random.default_rng(1234)
+@pytest.mark.parametrize("seed", [1234, 20260730])
+def test_thrash_kill_revive_under_io(seed):
+    rng = np.random.default_rng(seed)
     mon = Monitor()
     daemons: dict[int, OSDDaemon] = {}
     stores: dict[int, object] = {}
@@ -39,7 +42,7 @@ def test_thrash_kill_revive_under_io():
     def do_io(n_ops: int) -> None:
         nonlocal obj_seq
         for _ in range(n_ops):
-            op = rng.choice(["write", "read", "remove"])
+            op = rng.choice(["write", "overwrite", "read", "remove"])
             if op == "write" or not model:
                 oid = f"obj{obj_seq}"
                 obj_seq += 1
@@ -48,6 +51,19 @@ def test_thrash_kill_revive_under_io():
                 ).tobytes()
                 io.write(oid, blob)
                 model[oid] = blob
+            elif op == "overwrite":
+                # partial RMW overwrite — the thrash-erasure-code-
+                # overwrites tier (parity delta / hinfo-cleared paths)
+                oid = sorted(model)[int(rng.integers(0, len(model)))]
+                cur = bytearray(model[oid])
+                off = int(rng.integers(0, len(cur)))
+                ln = int(rng.integers(1, 2_000))
+                patch = rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+                io.write(oid, patch, offset=off)
+                if len(cur) < off + ln:
+                    cur.extend(b"\0" * (off + ln - len(cur)))
+                cur[off:off + ln] = patch
+                model[oid] = bytes(cur)
             elif op == "read":
                 oid = sorted(model)[int(rng.integers(0, len(model)))]
                 assert io.read(oid) == model[oid], f"stale read of {oid}"
