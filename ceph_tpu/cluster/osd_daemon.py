@@ -291,6 +291,8 @@ class OSDDaemon:
         self._pgs: dict[tuple[str, int], _PG] = {}
         self._backfills: dict[tuple[str, int], threading.Thread] = {}
         self.tick_period = tick_period
+        self._doomed_pool_ids: set[int] = set()
+        self._gc_clean_streak = 2  # nothing doomed yet
         self._tick_stop: threading.Event | None = None
         self._tick_thread: threading.Thread | None = None
         #: mClock QoS arbitration between client IO and background
@@ -386,6 +388,14 @@ class OSDDaemon:
         with self._pg_lock:
             if osdmap.epoch < self.osdmap.epoch:
                 return  # late delivery from a racing notifier thread
+            # pool identity is the ID (names are reusable, ids never
+            # are) — and deletions accumulate so a skipped epoch or a
+            # straggler write can't leak keys forever
+            live_ids = {s.pool_id for s in osdmap.pools.values()}
+            for spec in self.osdmap.pools.values():
+                if spec.pool_id not in live_ids:
+                    self._doomed_pool_ids.add(spec.pool_id)
+                    self._gc_clean_streak = 0
             self.osdmap = osdmap
             for osd, info in osdmap.osds.items():
                 if osd == self.osd_id:
@@ -466,10 +476,55 @@ class OSDDaemon:
             else:
                 with self._pg_lock:
                     self._pgs.pop((pool, pgid), None)
+        self._maybe_gc_pools()
         # temp-head adoption: whoever serves as primary under a
         # pg_temp mapping drives its backfill (covers temps installed
         # by OTHER daemons and primaries without a PG instance)
         self._adopt_pg_temps()
+
+    def _maybe_gc_pools(self) -> None:
+        if self._doomed_pool_ids and self._gc_clean_streak < 2:
+            threading.Thread(target=self._gc_pools, daemon=True).start()
+
+    def _gc_pools(self) -> None:
+        """A deleted pool's shard data is garbage (its id is never
+        reused): drop every key it owned (the reference's async pool
+        deletion sweep). Re-runs on later map changes/ticks until TWO
+        consecutive sweeps find nothing — stragglers from ops in
+        flight at deletion time get caught by the second pass."""
+        doomed = set(self._doomed_pool_ids)
+        batch: list[str] = []
+        removed = 0
+
+        def flush() -> None:
+            nonlocal removed
+            if not batch:
+                return
+            self.admit("gc")
+            txn = Transaction()
+            for key in batch:
+                txn.touch(key).remove(key)
+            try:
+                self.store.queue_transactions(txn)
+                removed += len(batch)
+            except Exception:
+                pass  # retried by the next sweep
+            batch.clear()
+
+        for key in self.store.list_objects():
+            try:
+                loc, _si = split_shard_key(key)
+                pool_id, _oid = split_loc(loc)
+            except ValueError:
+                continue
+            if pool_id in doomed:
+                batch.append(key)
+                if len(batch) >= 64:
+                    flush()
+        flush()
+        self._gc_clean_streak = 0 if removed else (
+            self._gc_clean_streak + 1
+        )
 
     def _adopt_pg_temps(self) -> None:
         osdmap = self.osdmap
@@ -789,8 +844,10 @@ class OSDDaemon:
     def tick(self) -> None:
         """Periodic maintenance: restart stalled backfills for PGs I
         serve under pg_temp (a failed pass leaves the temp mapping in
-        place; the tick is the retry seam)."""
+        place; the tick is the retry seam) and finish pool-deletion
+        sweeps."""
         self._adopt_pg_temps()
+        self._maybe_gc_pools()
 
     def _backfill_pg(self, pool: str, pgid: int, pg: _PG) -> None:
         """Move every object of the PG to its CRUSH target layout,

@@ -54,6 +54,7 @@ BALANCED_PROFILE = {
     "recovery": ClientProfile(reservation=25.0, weight=1.0, limit=100.0),
     "backfill": ClientProfile(reservation=10.0, weight=0.5, limit=100.0),
     "scrub": ClientProfile(reservation=0.0, weight=0.2, limit=50.0),
+    "gc": ClientProfile(reservation=0.0, weight=0.2, limit=50.0),
 }
 
 

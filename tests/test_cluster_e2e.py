@@ -307,3 +307,34 @@ def test_object_deleted_during_gap_not_resurrected(cluster):
     assert not loc_keys()                 # stale copy purged
     with pytest.raises(FileNotFoundError):
         io.stat("obj")
+
+
+def test_pool_deletion_gcs_shard_data(cluster):
+    """osd_pool_rm sweeps the pool's shard keys off every OSD (the
+    async pool-deletion GC); other pools' data is untouched."""
+    import time
+
+    mon, daemons, client = cluster
+    mon.osd_erasure_code_profile_set(
+        "rs21", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": "2", "m": "1"}
+    )
+    mon.osd_pool_create("doomed", 4, "rs21")
+    io_keep = client.open_ioctx("ecpool")
+    io_doom = client.open_ioctx("doomed")
+    io_keep.write("keep", payload(2_000))
+    io_doom.write("bye", payload(2_000))
+    doomed_id = mon.osdmap.pools["doomed"].pool_id
+    mon.osd_pool_rm("doomed")
+    end = time.monotonic() + 15
+
+    def leftovers():
+        return [
+            k for d in daemons for k in d.store.list_objects()
+            if k.startswith(f"{doomed_id}:")
+        ]
+
+    while leftovers() and time.monotonic() < end:
+        time.sleep(0.05)
+    assert not leftovers()
+    assert io_keep.read("keep") == payload(2_000)
