@@ -87,13 +87,34 @@ class StripedIoCtx:
 
     # -- IO surface (rados_striper_{write,read,stat,remove}) -----------
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        """Pieces land on different PGs/primaries: fan the per-piece
+        writes out via aio and wait for all (the striper's point is
+        exactly this parallelism)."""
+        comps = []
         pos = 0
         for idx, obj_off, length in self._extents(offset, len(data)):
-            self.io.write(
-                self._piece(oid, idx), data[pos:pos + length], obj_off
+            comps.append(
+                self.io.aio_write(
+                    self._piece(oid, idx),
+                    data[pos:pos + length],
+                    offset=obj_off,
+                )
             )
             pos += length
+        # wait for EVERY completion even after a failure (abandoned
+        # aio writes still land), then record the size covering all
+        # submitted extents so the landed pieces stay reachable by
+        # read (as zeros-for-failed sparse ranges) and reclaimable by
+        # remove — THEN surface the first error.
+        first_err = None
+        for c in comps:
+            try:
+                c.wait_for_complete()
+            except Exception as e:
+                first_err = first_err or e
         self._bump_size(oid, offset + len(data))
+        if first_err is not None:
+            raise first_err
 
     def read(self, oid: str, offset: int = 0, length: int | None = None) -> bytes:
         # clamp to the logical size (raises for absent objects): reads
@@ -106,10 +127,15 @@ class StripedIoCtx:
             length, size - offset
         )
         out = bytearray(length)
+        runs = self._extents(offset, length)
+        comps = [
+            self.io.aio_read(self._piece(oid, idx), obj_off, run)
+            for idx, obj_off, run in runs
+        ]
         pos = 0
-        for idx, obj_off, run in self._extents(offset, length):
+        for (idx, obj_off, run), c in zip(runs, comps):
             try:
-                buf = self.io.read(self._piece(oid, idx), obj_off, run)
+                buf = c.wait_for_complete().data
             except FileNotFoundError:
                 buf = b""
             out[pos:pos + len(buf)] = buf  # holes stay zero

@@ -68,6 +68,15 @@ class FileStore:
         if isinstance(txns, Transaction):
             txns = [txns]
         with self._lock:
+            if not txns:  # MemStore parity: an empty batch commits
+                self.committed_seq += 1
+                return self.committed_seq
+            # A journal left over from a FAILED apply (exception midway
+            # through step 2) holds committed intent: converge it first
+            # exactly like crash recovery would — otherwise this call's
+            # retire step would unlink it unreplayed.
+            if os.path.exists(self.journal_path):
+                self._replay()
             # 0. validate — same atomicity contract as MemStore: a
             #    failing op leaves no partial state, so check every op
             #    against simulated existence/attr state up front.
@@ -89,7 +98,8 @@ class FileStore:
                 os.fsync(rd)
             finally:
                 os.close(rd)
-            # 2. apply
+            # 2. apply — on failure the journal is LEFT IN PLACE: the
+            #    next commit (or the next open) replays it to converge
             for txn in txns:
                 self._apply(txn)
             # 3. make the applied state durable BEFORE retiring the

@@ -189,3 +189,36 @@ def test_filestore_replay_is_idempotent(tmp_path):
     st._apply(txn)  # applied, then "crash" before retire
     st2 = FileStore(root)  # replays REMOVE of already-gone object: no-op
     assert not st2.exists("o")
+
+
+def test_empty_batch_commits(st):
+    assert st.queue_transactions([]) == 1
+    assert st.queue_transactions(Transaction().touch("o")) == 2
+
+
+def test_filestore_failed_apply_converges_on_next_commit(tmp_path):
+    """An exception midway through apply leaves the journal in place;
+    the NEXT commit replays it first, so the journaled intent is never
+    silently discarded."""
+    root = str(tmp_path / "fs")
+    st = FileStore(root)
+    st.queue_transactions(Transaction().write("o", 0, b"base"))
+    txn = Transaction().write("o", 0, b"GOOD").write("p", 0, b"NEW")
+    orig = st._apply_op
+    calls = {"n": 0}
+
+    def exploding(op, strict=True):
+        calls["n"] += 1
+        if calls["n"] == 2:  # fail midway through the batch
+            raise OSError("injected device error")
+        return orig(op, strict)
+
+    st._apply_op = exploding
+    with pytest.raises(OSError):
+        st.queue_transactions(txn)
+    st._apply_op = orig
+    assert os.path.exists(st.journal_path)  # intent preserved
+    st.queue_transactions(Transaction().touch("q"))  # replays first
+    assert st.read("o") == b"GOOD"
+    assert st.read("p") == b"NEW"
+    assert not os.path.exists(st.journal_path)
