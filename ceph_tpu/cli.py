@@ -30,7 +30,7 @@ import time
 
 from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
 from ceph_tpu.cluster.mon_store import MonStore
-from ceph_tpu.cluster.osd_daemon import SHARD_NONE, split_loc, split_shard_key
+from ceph_tpu.cluster.osd_daemon import SHARD_NONE
 from ceph_tpu.store import BlockStore, FileStore
 
 
@@ -115,21 +115,6 @@ class Cluster:
             d.stop()
             if hasattr(d.store, "close"):
                 d.store.close()
-
-    # -- object listing (the rados ls role: union of shard scans) ------
-    def list_objects(self, pool: str) -> list[str]:
-        spec = self.mon.osdmap.pools[pool]
-        oids = set()
-        for d in self.daemons.values():
-            for key in d.store.list_objects():
-                try:
-                    loc, _si = split_shard_key(key)
-                    pool_id, oid = split_loc(loc)
-                except ValueError:
-                    continue
-                if pool_id == spec.pool_id:
-                    oids.add(oid)
-        return sorted(oids)
 
 
 def cmd_vstart(cl: Cluster, args) -> int:
@@ -227,7 +212,9 @@ def cmd_rm(cl: Cluster, args) -> int:
 
 
 def cmd_ls(cl: Cluster, args) -> int:
-    for oid in cl.list_objects(args.pool):
+    # the client-visible listing (PGLS through primaries), not a
+    # direct store peek
+    for oid in cl.client.open_ioctx(args.pool).list_objects():
         print(oid)
     return 0
 

@@ -98,7 +98,10 @@ class Objecter:
                 time.sleep(self.backoff * (2 ** (attempt - 1)))
             osdmap = self.monitor.osdmap  # refresh before each attempt
             try:
-                primary = osdmap.primary(pool, oid)
+                if op == "pgls":  # PG-addressed: offset carries pgid
+                    primary = osdmap.pg_primary(pool, offset)
+                else:
+                    primary = osdmap.primary(pool, oid)
             except KeyError as e:
                 raise FileNotFoundError(str(e)) from None
             if primary == SHARD_NONE:
@@ -252,6 +255,22 @@ class IoCtx:
 
     def remove(self, oid: str) -> None:
         self.objecter.submit(self.pool, oid, "remove")
+
+    def list_objects(self) -> list[str]:
+        """rados ls: PGLS every PG through its primary (the reference
+        client iterates placement groups the same way)."""
+        import json as _json
+
+        spec = self.objecter.monitor.osdmap.pools.get(self.pool)
+        if spec is None:
+            raise FileNotFoundError(f"no such pool: {self.pool!r}")
+        oids: set[str] = set()
+        for pgid in range(spec.pg_num):
+            reply = self.objecter.submit(
+                self.pool, f"pg{pgid}", "pgls", offset=pgid
+            )
+            oids.update(_json.loads(reply.data.decode()))
+        return sorted(oids)
 
     # -- async surface (rados_aio_write/read/remove) -------------------
     def aio_write(

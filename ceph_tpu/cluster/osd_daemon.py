@@ -740,9 +740,13 @@ class OSDDaemon:
         spec = self.osdmap.pools.get(msg.pool)
         if spec is None:
             return OSDOpReply(msg.tid, epoch, error="enoent")
-        acting = self.osdmap.object_to_acting(msg.pool, msg.oid)
-        primary = next((o for o in acting if o != SHARD_NONE), SHARD_NONE)
-        if primary != self.osd_id:
+        if msg.op == "pgls":
+            # PG-addressed, not object-addressed: offset carries pgid
+            pgid = msg.offset
+            if self.osdmap.pg_primary(msg.pool, pgid) != self.osd_id:
+                return OSDOpReply(msg.tid, epoch, error="eagain")
+            return self._op_pgls(msg, spec, pgid)
+        if self.osdmap.primary(msg.pool, msg.oid) != self.osd_id:
             return OSDOpReply(msg.tid, epoch, error="eagain")
         pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
         msg.oid = make_loc(spec.pool_id, msg.oid)  # pool-scoped store key
@@ -820,6 +824,32 @@ class OSDDaemon:
             with self._pg_lock:
                 pg.backfill_dirty.add(msg.oid)
         return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    def _op_pgls(self, msg, spec, pgid: int):
+        """List one PG's objects (the PGLS op behind rados ls). The
+        primary's own scan suffices when its acting set is whole
+        (every write touched it); peers are consulted only when the
+        set has holes/recovering members — an object written while MY
+        position was a hole must still list."""
+        import json as _json
+
+        pg = self._get_pg(msg.pool, pgid)
+        degraded = pg.backend.recovering or any(
+            o == SHARD_NONE for o in pg.acting
+        )
+        if degraded:
+            locs = set(self._backfill_scan(msg.pool, pgid, spec, pg))
+        else:
+            locs = {
+                loc for loc, _si in self._scan_pg_keys(
+                    spec.pool_id, spec.pg_num, pgid
+                )
+            }
+        oids = sorted(split_loc(loc)[1] for loc in locs)
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch,
+            data=_json.dumps(oids).encode(),
+        )
 
     # -- backfill (rebalance data movement, pg_temp-protected) ----------
     def _request_pg_temp(self, pool: str, pgid: int, pg: _PG) -> bool:

@@ -253,13 +253,18 @@ class OSDMap:
     def object_to_acting(self, pool: str, oid: str) -> list[int]:
         return self.pg_to_up_acting(pool, self.object_to_pg(pool, oid))
 
-    def primary(self, pool: str, oid: str) -> int:
-        """First live shard-holder (the EC primary rule); SHARD_NONE
-        if every acting shard is down."""
-        for o in self.object_to_acting(pool, oid):
+    def pg_primary(self, pool: str, pg: int) -> int:
+        """First live shard-holder of a PG (the EC primary rule);
+        SHARD_NONE if every acting shard is down. THE primary
+        selection — client targeting and OSD self-identification must
+        agree for the eagain retry contract to converge."""
+        for o in self.pg_to_up_acting(pool, pg):
             if o != SHARD_NONE:
                 return o
         return SHARD_NONE
+
+    def primary(self, pool: str, oid: str) -> int:
+        return self.pg_primary(pool, self.object_to_pg(pool, oid))
 
     def _pool(self, pool: str) -> PoolSpec:
         spec = self.pools.get(pool)

@@ -338,3 +338,21 @@ def test_pool_deletion_gcs_shard_data(cluster):
         time.sleep(0.05)
     assert not leftovers()
     assert io_keep.read("keep") == payload(2_000)
+
+
+def test_rados_ls_lists_through_primaries(cluster):
+    """IoCtx.list_objects is the PGLS surface: complete across PGs and
+    primaries, excludes removed objects, and still works degraded."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    names = sorted(f"ls/{i}" for i in range(10))
+    for n in names:
+        io.write(n, payload(700, seed=len(n)))
+    assert io.list_objects() == names
+    io.remove(names[3])
+    expect = names[:3] + names[4:]
+    assert io.list_objects() == expect
+    victim = mon.osdmap.object_to_acting("ecpool", names[0])[0]
+    daemons[victim].stop()
+    mon.osd_down(victim)
+    assert io.list_objects() == expect  # new primaries serve the list
