@@ -90,6 +90,7 @@ class Objecter:
         offset: int = 0,
         length: int = 0,
         data: bytes = b"",
+        name: str = "",
     ) -> OSDOpReply:
         last = "no attempt made"
         for attempt in range(self.max_attempts):
@@ -118,7 +119,7 @@ class Objecter:
             try:
                 self._conn(addr).send(
                     OSDOp(tid, osdmap.epoch, pool, oid, op,
-                          offset, length, data)
+                          offset, length, data, name)
                 )
                 if not entry["event"].wait(self.op_timeout):
                     last = f"osd.{primary} timed out"
@@ -137,6 +138,8 @@ class Objecter:
                 continue
             if reply.error == "enoent":
                 raise FileNotFoundError(f"{pool}/{oid}")
+            if reply.error == "enodata":
+                raise KeyError(f"{pool}/{oid}: no such xattr")
             if reply.error == "eio":
                 raise IOError(reply.data.decode() or f"eio on {pool}/{oid}")
             return reply
@@ -255,6 +258,29 @@ class IoCtx:
 
     def remove(self, oid: str) -> None:
         self.objecter.submit(self.pool, oid, "remove")
+
+    # -- xattrs (rados_{get,set,rm}xattr + getxattrs) ------------------
+    def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        self.objecter.submit(
+            self.pool, oid, "setxattr", data=bytes(value), name=name
+        )
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        return self.objecter.submit(
+            self.pool, oid, "getxattr", name=name
+        ).data
+
+    def rmxattr(self, oid: str, name: str) -> None:
+        self.objecter.submit(self.pool, oid, "rmxattr", name=name)
+
+    def getxattrs(self, oid: str) -> dict[str, bytes]:
+        import json as _json
+
+        reply = self.objecter.submit(self.pool, oid, "getxattrs")
+        return {
+            k: bytes.fromhex(v)
+            for k, v in _json.loads(reply.data.decode()).items()
+        }
 
     def list_objects(self) -> list[str]:
         """rados ls: PGLS every PG through its primary (the reference

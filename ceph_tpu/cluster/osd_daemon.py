@@ -261,6 +261,7 @@ class _PG:
             lambda oid: daemon._object_size(self, oid),
             self.rmw.hinfo,
             perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.recovery",
+            user_attrs_fn=lambda oid: daemon._user_attrs(self, oid),
         )
 
 
@@ -583,8 +584,10 @@ class OSDDaemon:
             for _ in range(8):
                 self.admit("recovery")
                 pg.recovery.recover_from_log(pg.pglog, shard)
-                if not pg.pglog.dirty_extents(shard) and not (
-                    pg.pglog.dirty_deletes(shard)
+                if (
+                    not pg.pglog.dirty_extents(shard)
+                    and not pg.pglog.dirty_deletes(shard)
+                    and not pg.pglog.dirty_xattrs(shard)
                 ):
                     break
             pg.backend.recovering.discard(shard)
@@ -638,6 +641,26 @@ class OSDDaemon:
     def _have_object(self, pg: _PG, oid: str) -> bool:
         key = self._my_key(pg, oid)
         return key is not None and self.store.exists(key)
+
+    def _user_attrs(self, pg: _PG, oid: str) -> dict[str, bytes]:
+        """The primary's user-xattr map for an object (u:-prefixed),
+        restored onto recovered shards alongside the identity attrs."""
+        key = self._my_key(pg, oid)
+        if key is None:
+            return {}
+        try:
+            return {
+                k: v for k, v in self.store.getattrs(key).items()
+                if k.startswith("u:")
+            }
+        except FileNotFoundError:
+            return {}
+
+    def _object_exists(self, pg: _PG, oid: str) -> bool:
+        """The client-visible existence test the op handlers share."""
+        return bool(self._object_size(pg, oid)) or self._have_object(
+            pg, oid
+        )
 
     def _object_size(self, pg: _PG, oid: str) -> int:
         size = pg.rmw.object_size(oid)
@@ -757,12 +780,18 @@ class OSDDaemon:
             if msg.op == "read":
                 return self._op_read(pg, msg)
             if msg.op == "stat":
-                size = self._object_size(pg, msg.oid)
-                if not size and not self._have_object(pg, msg.oid):
+                if not self._object_exists(pg, msg.oid):
                     return OSDOpReply(msg.tid, epoch, error="enoent")
+                size = self._object_size(pg, msg.oid)
                 return OSDOpReply(msg.tid, epoch, size=size)
             if msg.op == "remove":
                 return self._op_remove(pg, msg)
+            if msg.op in ("setxattr", "rmxattr"):
+                return self._op_setxattr(pg, msg)
+            if msg.op == "getxattr":
+                return self._op_getxattr(pg, msg)
+            if msg.op == "getxattrs":
+                return self._op_getxattrs(pg, msg)
             return OSDOpReply(msg.tid, epoch, error="eio",
                               data=f"bad op {msg.op!r}".encode())
 
@@ -787,9 +816,9 @@ class OSDDaemon:
         )
 
     def _op_read(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
-        size = self._object_size(pg, msg.oid)
-        if not size and not self._have_object(pg, msg.oid):
+        if not self._object_exists(pg, msg.oid):
             return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        size = self._object_size(pg, msg.oid)
         length = msg.length if msg.length else max(size - msg.offset, 0)
         done: list = []
         pg.reads.submit(
@@ -807,9 +836,7 @@ class OSDDaemon:
         )
 
     def _op_remove(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
-        if not self._object_size(pg, msg.oid) and not self._have_object(
-            pg, msg.oid
-        ):
+        if not self._object_exists(pg, msg.oid):
             return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
         done: list = []
         pg.rmw.submit_remove(msg.oid, on_commit=lambda op: done.append(op))
@@ -849,6 +876,63 @@ class OSDDaemon:
         return OSDOpReply(
             msg.tid, self.osdmap.epoch,
             data=_json.dumps(oids).encode(),
+        )
+
+    def _op_setxattr(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        if not self._object_exists(pg, msg.oid):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        value = msg.data if msg.op == "setxattr" else None
+        done: list = []
+        pg.rmw.submit_setxattr(
+            msg.oid, msg.name, value, on_commit=lambda op: done.append(op)
+        )
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        op = done[0]
+        if op.error is not None:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=str(op.error).encode(),
+            )
+        if pg.backfilling:
+            with self._pg_lock:
+                pg.backfill_dirty.add(msg.oid)  # re-pushed pre-cutover
+        return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    def _op_getxattr(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        if not self._object_exists(pg, msg.oid):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        key = self._my_key(pg, msg.oid)
+        try:
+            val = self.store.getattr(key, "u:" + msg.name)
+        except FileNotFoundError:
+            # the OBJECT is missing from my own shard (written while
+            # my position was a hole, not yet refreshed): a degraded-
+            # metadata condition, NOT proof the attr doesn't exist
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=b"primary shard copy missing (recovering)",
+            )
+        except KeyError:
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enodata")
+        return OSDOpReply(msg.tid, self.osdmap.epoch, data=val)
+
+    def _op_getxattrs(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        import json as _json
+
+        if not self._object_exists(pg, msg.oid):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        key = self._my_key(pg, msg.oid)
+        if key is None or not self.store.exists(key):
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=b"primary shard copy missing (recovering)",
+            )
+        attrs = self._user_attrs(pg, msg.oid)
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch,
+            data=_json.dumps(
+                {k[2:]: v.hex() for k, v in attrs.items()}
+            ).encode(),
         )
 
     # -- backfill (rebalance data movement, pg_temp-protected) ----------
@@ -998,6 +1082,7 @@ class OSDDaemon:
             )
         except (FileNotFoundError, KeyError):
             hinfo_bytes = None
+        user_attrs = self._user_attrs(pg, oid)
         for i in moves:
             key = shard_key(oid, i)
             buf = bytes(smap.get(i, 0, shard_len))
@@ -1007,6 +1092,8 @@ class OSDDaemon:
                 txn.setattr(key, HINFO_KEY, hinfo_bytes)
             txn.setattr(key, OI_KEY, str(size).encode())
             txn.setattr(key, SI_KEY, str(i).encode())
+            for aname, aval in user_attrs.items():
+                txn.setattr(key, aname, aval)
             self._push_shard_txn(target[i], txn)
 
     def _push_delete(self, osd: int, loc: str, shard: int) -> None:

@@ -218,10 +218,11 @@ class OSDOp:
     epoch: int
     pool: str
     oid: str
-    op: str  # "write" | "read" | "stat" | "remove"
+    op: str  # write | read | stat | remove | pgls | *xattr*
     offset: int = 0
     length: int = 0
     data: bytes = b""
+    name: str = ""  # xattr name for the *xattr ops
 
     def encode(self) -> list[bytes]:
         return [
@@ -235,6 +236,7 @@ class OSDOp:
                     "op": self.op,
                     "offset": self.offset,
                     "length": self.length,
+                    "name": self.name,
                 },
             ),
             self.data,
@@ -245,7 +247,7 @@ class OSDOp:
         h = _parse(segments[0], "osd_op")
         return cls(
             h["tid"], h["epoch"], h["pool"], h["oid"], h["op"],
-            h["offset"], h["length"], segments[1],
+            h["offset"], h["length"], segments[1], h.get("name", ""),
         )
 
 

@@ -28,12 +28,15 @@ from .extents import ExtentSet
 class LogEntry:
     """One client op (the pg_log_entry_t analog). ``delete`` entries
     (pg_log_entry_t::DELETE) touch every shard and supersede earlier
-    writes of the oid for recovery purposes."""
+    writes of the oid for recovery purposes. ``xattrs`` records user-
+    attr mutations (value None = removed) — they replicate to every
+    shard, so replay needs them like data extents."""
 
     tid: int
     oid: str
     shard_extents: dict[int, ExtentSet] = field(default_factory=dict)
     delete: bool = False
+    xattrs: "dict[str, bytes | None] | None" = None
 
 
 class PGLog:
@@ -60,6 +63,14 @@ class PGLog:
         if self.entries and tid <= self.entries[-1].tid:
             raise ValueError(f"non-monotonic log append: tid {tid}")
         self.entries.append(LogEntry(tid, oid, {}, delete=True))
+
+    def append_xattrs(
+        self, tid: int, oid: str, xattrs: "dict[str, bytes | None]"
+    ) -> None:
+        """Record user-attr mutations (None = removal)."""
+        if self.entries and tid <= self.entries[-1].tid:
+            raise ValueError(f"non-monotonic log append: tid {tid}")
+        self.entries.append(LogEntry(tid, oid, {}, xattrs=dict(xattrs)))
 
     def ack(self, shard: int, tid: int) -> None:
         """A shard durably applied its sub-write for ``tid``."""
@@ -125,6 +136,23 @@ class PGLog:
                 out.add(e.oid)
             elif e.shard_extents.get(shard):
                 out.discard(e.oid)  # recreated after the delete
+        return out
+
+    def dirty_xattrs(
+        self, shard: int
+    ) -> "dict[str, dict[str, bytes | None]]":
+        """Per-object FINAL user-attr state this shard is missing
+        (entries past its frontier; a delete resets the object)."""
+        frontier = self._completed[shard]
+        out: dict[str, dict[str, bytes | None]] = {}
+        for e in self.entries:
+            if e.tid <= frontier:
+                continue
+            if e.delete:
+                out.pop(e.oid, None)
+                continue
+            if e.xattrs:
+                out.setdefault(e.oid, {}).update(e.xattrs)
         return out
 
     def mark_recovered(self, shard: int, up_to: int | None = None) -> None:

@@ -82,12 +82,16 @@ class RecoveryBackend:
         size_fn,
         hinfo_fn,
         perf_name: str = "ec_recovery",
+        user_attrs_fn=None,
     ) -> None:
         self.sinfo = sinfo
         self.codec = codec
         self.backend = backend
         self.size_fn = size_fn
         self.hinfo_fn = hinfo_fn
+        #: oid -> {attr name: bytes} of USER attrs to restore with a
+        #: push (the primary's copy — user xattrs replicate everywhere)
+        self.user_attrs_fn = user_attrs_fn
         from ceph_tpu.utils import PerfCountersBuilder, perf_collection
 
         self.perf = (
@@ -269,6 +273,10 @@ class RecoveryBackend:
         # still carry the object (touch) and its hinfo attr, exactly
         # as the original write's per-shard transaction did.
         op.pending_pushes = set(op.missing)
+        user_attrs = (
+            self.user_attrs_fn(op.oid)
+            if self.user_attrs_fn is not None else {}
+        )
         for shard in sorted(op.missing):
             txn = Transaction().touch(op.oid)
             for start, end in op.want.get(shard, ExtentSet()):
@@ -282,6 +290,8 @@ class RecoveryBackend:
             # misplacement guard
             txn.setattr(op.oid, OI_KEY, str(size).encode())
             txn.setattr(op.oid, SI_KEY, str(shard).encode())
+            for aname, aval in user_attrs.items():
+                txn.setattr(op.oid, aname, aval)
             self.backend.submit_shard_txn(
                 shard,
                 txn,
@@ -315,6 +325,23 @@ class RecoveryBackend:
             ops[oid] = self.recover_object(
                 oid, {shard}, extents={shard: extents}
             )
+        # user-xattr replay: push the FINAL attr state the shard missed
+        # (tombstones as tolerant rmattrs — it may never have had them)
+        xdirty = pglog.dirty_xattrs(shard)
+        xpending: set[str] = set()
+        for oid, attrs in sorted(xdirty.items()):
+            txn = Transaction().touch(oid)
+            for name, val in sorted(attrs.items()):
+                if val is None:
+                    txn.rmattr(oid, "u:" + name, ignore_missing=True)
+                else:
+                    txn.setattr(oid, "u:" + name, val)
+            xpending.add(oid)
+            self.backend.submit_shard_txn(
+                shard, txn, lambda o=oid: xpending.discard(o)
+            )
+        if xpending and drain is not None:
+            drain(lambda: not xpending)
         pglog.mark_recovered(shard, head)
         return ops
 

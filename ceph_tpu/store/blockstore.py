@@ -312,9 +312,12 @@ class BlockStore:
         elif op.kind is OpKind.SETATTR:
             onode = self._get(staged, op.oid, create=True)
             onode.attrs[op.name] = op.data
-        elif op.kind is OpKind.RMATTR:
+        elif op.kind in (OpKind.RMATTR, OpKind.RMATTR_TOLERANT):
             onode = staged.get(op.oid)
             if onode is None or op.name not in onode.attrs:
+                if op.kind is OpKind.RMATTR_TOLERANT:
+                    self._get(staged, op.oid, create=True)
+                    return
                 raise KeyError(f"{op.oid}:{op.name}")
             del onode.attrs[op.name]
 

@@ -151,8 +151,11 @@ class FileStore:
                         raise FileNotFoundError(op.oid)
                     exists[op.oid] = False
                     attrs[op.oid] = set()
-                elif op.kind is OpKind.RMATTR:
+                elif op.kind in (OpKind.RMATTR, OpKind.RMATTR_TOLERANT):
                     if op.name not in attr_names(op.oid):
+                        if op.kind is OpKind.RMATTR_TOLERANT:
+                            exists[op.oid] = True
+                            continue
                         raise KeyError(f"{op.oid}:{op.name}")
                     attrs[op.oid].discard(op.name)
                 elif op.kind is OpKind.SETATTR:
@@ -206,10 +209,11 @@ class FileStore:
             attrs = self._load_attrs(op.oid)
             attrs[op.name] = op.data
             self._store_attrs(op.oid, attrs)
-        elif op.kind is OpKind.RMATTR:
+        elif op.kind in (OpKind.RMATTR, OpKind.RMATTR_TOLERANT):
             attrs = self._load_attrs(op.oid)
             if op.name not in attrs:
-                if not strict:
+                if not strict or op.kind is OpKind.RMATTR_TOLERANT:
+                    self._ensure(data_path)
                     return
                 raise KeyError(f"{op.oid}:{op.name}")
             del attrs[op.name]

@@ -104,9 +104,12 @@ class MemStore:
             obj = get(op.oid, create=True)
             obj.attrs[op.name] = op.data
             return
-        if op.kind is OpKind.RMATTR:
+        if op.kind in (OpKind.RMATTR, OpKind.RMATTR_TOLERANT):
             obj = get(op.oid, create=False)
             if obj is None or op.name not in obj.attrs:
+                if op.kind is OpKind.RMATTR_TOLERANT:
+                    get(op.oid, create=True)
+                    return
                 raise KeyError(f"{op.oid}:{op.name}")
             del obj.attrs[op.name]
             return

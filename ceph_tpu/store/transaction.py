@@ -22,6 +22,9 @@ class OpKind(enum.Enum):
     REMOVE = "remove"
     SETATTR = "setattr"
     RMATTR = "rmattr"
+    #: rmattr that no-ops when the attr is absent — the xattr-
+    #: tombstone replay path, where the target may never have had it
+    RMATTR_TOLERANT = "rmattr_tolerant"
 
 
 @dataclass
@@ -67,8 +70,13 @@ class Transaction:
         self.ops.append(Op(OpKind.SETATTR, oid, name=name, data=bytes(value)))
         return self
 
-    def rmattr(self, oid: str, name: str) -> "Transaction":
-        self.ops.append(Op(OpKind.RMATTR, oid, name=name))
+    def rmattr(
+        self, oid: str, name: str, ignore_missing: bool = False
+    ) -> "Transaction":
+        """Remove an attr; strict by default (KeyError when absent).
+        ``ignore_missing`` emits RMATTR_TOLERANT: a no-op on absence."""
+        kind = OpKind.RMATTR_TOLERANT if ignore_missing else OpKind.RMATTR
+        self.ops.append(Op(kind, oid, name=name))
         return self
 
     def append(self, other: "Transaction") -> "Transaction":

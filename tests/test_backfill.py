@@ -188,3 +188,18 @@ def test_write_during_pg_temp_window_not_lost(cluster):
     io.write("w0", new_data)
     wait_no_pg_temp(mon)
     assert io.read("w0") == new_data
+
+
+def test_xattrs_survive_backfill(cluster):
+    """User xattrs travel with backfill pushes: after a rebalance the
+    new layout serves them."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("obj", payload(3_000))
+    io.setxattr("obj", "owner", b"alice")
+    victim = mon.osdmap.object_to_acting("ecpool", "obj")[0]
+    mon.osd_down(victim)
+    mon.osd_out(victim)
+    wait_no_pg_temp(mon)
+    assert io.getxattr("obj", "owner") == b"alice"
+    assert io.getxattrs("obj") == {"owner": b"alice"}
