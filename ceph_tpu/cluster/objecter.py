@@ -282,6 +282,53 @@ class IoCtx:
             for k, v in _json.loads(reply.data.decode()).items()
         }
 
+    # -- omap (rados omap_set / get_vals_by_keys / get_keys2) ----------
+    def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
+        import json as _json
+
+        self.objecter.submit(
+            self.pool, oid, "omapset",
+            data=_json.dumps(
+                {k: v.hex() for k, v in kv.items()}
+            ).encode(),
+        )
+
+    def omap_rm(self, oid: str, keys: list[str]) -> None:
+        import json as _json
+
+        self.objecter.submit(
+            self.pool, oid, "omapset",
+            data=_json.dumps({k: None for k in keys}).encode(),
+        )
+
+    def omap_get(
+        self, oid: str, keys: "list[str] | None" = None
+    ) -> dict[str, bytes]:
+        import json as _json
+
+        reply = self.objecter.submit(
+            self.pool, oid, "omapget",
+            data=_json.dumps(keys).encode() if keys is not None else b"",
+        )
+        return {
+            k: bytes.fromhex(v)
+            for k, v in _json.loads(reply.data.decode()).items()
+        }
+
+    def omap_list(
+        self, oid: str, after: str = "", max_return: int = 0
+    ) -> list[tuple[str, bytes]]:
+        """Sorted (key, value) page starting strictly after ``after``."""
+        import json as _json
+
+        reply = self.objecter.submit(
+            self.pool, oid, "omaplist", length=max_return, name=after
+        )
+        return [
+            (k, bytes.fromhex(v))
+            for k, v in _json.loads(reply.data.decode())
+        ]
+
     def list_objects(self) -> list[str]:
         """rados ls: PGLS every PG through its primary (the reference
         client iterates placement groups the same way)."""

@@ -261,7 +261,7 @@ class _PG:
             lambda oid: daemon._object_size(self, oid),
             self.rmw.hinfo,
             perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.recovery",
-            user_attrs_fn=lambda oid: daemon._user_attrs(self, oid),
+            user_attrs_fn=lambda oid: daemon._replicated_attrs(self, oid),
         )
 
 
@@ -642,19 +642,25 @@ class OSDDaemon:
         key = self._my_key(pg, oid)
         return key is not None and self.store.exists(key)
 
-    def _user_attrs(self, pg: _PG, oid: str) -> dict[str, bytes]:
-        """The primary's user-xattr map for an object (u:-prefixed),
-        restored onto recovered shards alongside the identity attrs."""
+    def _replicated_attrs(
+        self, pg: _PG, oid: str, prefixes: tuple = ("u:", "m:")
+    ) -> dict[str, bytes]:
+        """The primary's replicated-attr map for an object (user
+        xattrs ``u:``, omap entries ``m:``), restored onto recovered
+        shards alongside the identity attrs."""
         key = self._my_key(pg, oid)
         if key is None:
             return {}
         try:
             return {
                 k: v for k, v in self.store.getattrs(key).items()
-                if k.startswith("u:")
+                if k.startswith(prefixes)
             }
         except FileNotFoundError:
             return {}
+
+    def _user_attrs(self, pg: _PG, oid: str) -> dict[str, bytes]:
+        return self._replicated_attrs(pg, oid, ("u:",))
 
     def _object_exists(self, pg: _PG, oid: str) -> bool:
         """The client-visible existence test the op handlers share."""
@@ -792,6 +798,12 @@ class OSDDaemon:
                 return self._op_getxattr(pg, msg)
             if msg.op == "getxattrs":
                 return self._op_getxattrs(pg, msg)
+            if msg.op == "omapset":
+                return self._op_omapset(pg, msg)
+            if msg.op == "omapget":
+                return self._op_omapget(pg, msg)
+            if msg.op == "omaplist":
+                return self._op_omaplist(pg, msg)
             return OSDOpReply(msg.tid, epoch, error="eio",
                               data=f"bad op {msg.op!r}".encode())
 
@@ -878,13 +890,31 @@ class OSDDaemon:
             data=_json.dumps(oids).encode(),
         )
 
-    def _op_setxattr(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+    def _meta_read_guard(
+        self, pg: _PG, msg: OSDOp
+    ) -> "OSDOpReply | None":
+        """Common gate for metadata reads served from the primary's
+        own shard copy: enoent when the object doesn't exist, a
+        degraded-metadata EIO when the object exists but MY copy is
+        missing (hole-written, not yet refreshed)."""
         if not self._object_exists(pg, msg.oid):
             return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
-        value = msg.data if msg.op == "setxattr" else None
+        key = self._my_key(pg, msg.oid)
+        if key is None or not self.store.exists(key):
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=b"primary shard copy missing (recovering)",
+            )
+        return None
+
+    def _run_attr_update(
+        self, pg: _PG, msg: OSDOp, updates: "dict[str, bytes | None]"
+    ) -> OSDOpReply:
+        """Submit one logged attr batch and wait for commit (shared by
+        the xattr and omap mutation handlers)."""
         done: list = []
-        pg.rmw.submit_setxattr(
-            msg.oid, msg.name, value, on_commit=lambda op: done.append(op)
+        pg.rmw.submit_attr_updates(
+            msg.oid, updates, on_commit=lambda op: done.append(op)
         )
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
@@ -895,8 +925,14 @@ class OSDDaemon:
             )
         if pg.backfilling:
             with self._pg_lock:
-                pg.backfill_dirty.add(msg.oid)  # re-pushed pre-cutover
+                pg.backfill_dirty.add(msg.oid)
         return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    def _op_setxattr(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        if not self._object_exists(pg, msg.oid):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        value = msg.data if msg.op == "setxattr" else None
+        return self._run_attr_update(pg, msg, {"u:" + msg.name: value})
 
     def _op_getxattr(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
         if not self._object_exists(pg, msg.oid):
@@ -919,19 +955,75 @@ class OSDDaemon:
     def _op_getxattrs(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
         import json as _json
 
-        if not self._object_exists(pg, msg.oid):
-            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
-        key = self._my_key(pg, msg.oid)
-        if key is None or not self.store.exists(key):
-            return OSDOpReply(
-                msg.tid, self.osdmap.epoch, error="eio",
-                data=b"primary shard copy missing (recovering)",
-            )
+        bad = self._meta_read_guard(pg, msg)
+        if bad is not None:
+            return bad
         attrs = self._user_attrs(pg, msg.oid)
         return OSDOpReply(
             msg.tid, self.osdmap.epoch,
             data=_json.dumps(
                 {k[2:]: v.hex() for k, v in attrs.items()}
+            ).encode(),
+        )
+
+    def _op_omapset(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        """Batched omap mutations: data = json {key: hex value | null
+        (remove)} — one ordered, logged commit for the whole batch
+        (rados omap_set/omap_rm_keys)."""
+        import json as _json
+
+        if not self._object_exists(pg, msg.oid):
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        try:
+            kv = _json.loads(msg.data.decode())
+            updates = {
+                "m:" + k: (bytes.fromhex(v) if v is not None else None)
+                for k, v in kv.items()
+            }
+        except (ValueError, AttributeError) as e:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=f"bad omap batch: {e}".encode(),
+            )
+        return self._run_attr_update(pg, msg, updates)
+
+    def _op_omapget(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        import json as _json
+
+        bad = self._meta_read_guard(pg, msg)
+        if bad is not None:
+            return bad
+        want = _json.loads(msg.data.decode()) if msg.data else None
+        attrs = self._replicated_attrs(pg, msg.oid, ("m:",))
+        out = {}
+        for k, v in attrs.items():
+            bare = k[2:]
+            if want is None or bare in want:
+                out[bare] = v.hex()
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch, data=_json.dumps(out).encode()
+        )
+
+    def _op_omaplist(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        """Sorted key range: name = start-after cursor, length = max
+        entries (rados omap_get_keys2 pagination shape)."""
+        import json as _json
+
+        bad = self._meta_read_guard(pg, msg)
+        if bad is not None:
+            return bad
+        attrs = self._replicated_attrs(pg, msg.oid, ("m:",))
+        keys = sorted(k[2:] for k in attrs)
+        if msg.name:
+            import bisect
+
+            keys = keys[bisect.bisect_right(keys, msg.name):]
+        limit = msg.length or len(keys)
+        page = keys[:limit]  # encode only the returned page's values
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch,
+            data=_json.dumps(
+                [[k, attrs["m:" + k].hex()] for k in page]
             ).encode(),
         )
 
@@ -1082,7 +1174,7 @@ class OSDDaemon:
             )
         except (FileNotFoundError, KeyError):
             hinfo_bytes = None
-        user_attrs = self._user_attrs(pg, oid)
+        user_attrs = self._replicated_attrs(pg, oid)
         for i in moves:
             key = shard_key(oid, i)
             buf = bytes(smap.get(i, 0, shard_len))

@@ -67,7 +67,8 @@ class PGLog:
     def append_xattrs(
         self, tid: int, oid: str, xattrs: "dict[str, bytes | None]"
     ) -> None:
-        """Record user-attr mutations (None = removal)."""
+        """Record replicated-attr mutations by FULL attr key
+        (u:/m:-prefixed; None = removal)."""
         if self.entries and tid <= self.entries[-1].tid:
             raise ValueError(f"non-monotonic log append: tid {tid}")
         self.entries.append(LogEntry(tid, oid, {}, xattrs=dict(xattrs)))

@@ -331,11 +331,11 @@ class RecoveryBackend:
         xpending: set[str] = set()
         for oid, attrs in sorted(xdirty.items()):
             txn = Transaction().touch(oid)
-            for name, val in sorted(attrs.items()):
+            for name, val in sorted(attrs.items()):  # FULL attr keys
                 if val is None:
-                    txn.rmattr(oid, "u:" + name, ignore_missing=True)
+                    txn.rmattr(oid, name, ignore_missing=True)
                 else:
-                    txn.setattr(oid, "u:" + name, val)
+                    txn.setattr(oid, name, val)
             xpending.add(oid)
             self.backend.submit_shard_txn(
                 shard, txn, lambda o=oid: xpending.discard(o)

@@ -417,37 +417,38 @@ class RMWPipeline:
         self.cache.execute([op.cache_op])
         return op.tid
 
-    def submit_setxattr(
+    def submit_attr_updates(
         self,
         oid: str,
-        name: str,
-        value: "bytes | None",
+        updates: "dict[str, bytes | None]",
         on_commit: Callable[[ClientOp], None] | None = None,
     ) -> int:
-        """User-xattr mutation (value None = remove), ordered through
-        the per-object cache FIFO like writes/removes, journaled in
-        the pg log so a down shard replays it on return. Stored under
-        the ``u:`` prefix so identity attrs (OI/SI/hinfo) never
-        collide with user names."""
+        """Replicated-attr mutations (value None = remove), ordered
+        through the per-object cache FIFO like writes/removes and
+        journaled in the pg log so a down shard replays them on
+        return. Keys are FULL attr names (callers prefix: ``u:`` for
+        user xattrs, ``m:`` for omap entries) so identity attrs never
+        collide and one batch may mix namespaces."""
         op = ClientOp(self._next_tid, oid, 0, b"", on_commit)
         op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
-        key = "u:" + name
+        updates = dict(updates)
 
         def dispatch(cop, _op=op) -> None:
             try:
                 live = set(self.backend.avail_shards())
                 if self.pglog is not None:
-                    self.pglog.append_xattrs(_op.tid, oid, {name: value})
+                    self.pglog.append_xattrs(_op.tid, oid, updates)
                 _op.pending_shards = set(live)
                 _op.written = ShardExtentMap(self.sinfo)
                 for shard in sorted(live):
                     txn = Transaction().touch(oid)
-                    if value is None:
-                        txn.rmattr(oid, key, ignore_missing=True)
-                    else:
-                        txn.setattr(oid, key, value)
+                    for key, value in sorted(updates.items()):
+                        if value is None:
+                            txn.rmattr(oid, key, ignore_missing=True)
+                        else:
+                            txn.setattr(oid, key, value)
                     self.backend.submit_shard_txn(
                         shard, txn,
                         lambda s=shard, o=_op: self._shard_ack(o, s),
@@ -458,6 +459,18 @@ class RMWPipeline:
         op.cache_op = self.cache.prepare(oid, {}, {}, 0, dispatch)
         self.cache.execute([op.cache_op])
         return op.tid
+
+    def submit_setxattr(
+        self,
+        oid: str,
+        name: str,
+        value: "bytes | None",
+        on_commit: Callable[[ClientOp], None] | None = None,
+    ) -> int:
+        """User-xattr mutation (the ``u:`` namespace convenience)."""
+        return self.submit_attr_updates(
+            oid, {"u:" + name: value}, on_commit
+        )
 
     def object_size(self, oid: str) -> int:
         return self._object_sizes.get(oid, 0)

@@ -203,3 +203,18 @@ def test_xattrs_survive_backfill(cluster):
     wait_no_pg_temp(mon)
     assert io.getxattr("obj", "owner") == b"alice"
     assert io.getxattrs("obj") == {"owner": b"alice"}
+
+
+def test_omap_survives_backfill(cluster):
+    """Omap entries (m: attrs) travel with backfill pushes like user
+    xattrs do."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("idx", payload(2_000))
+    io.omap_set("idx", {"a": b"1", "b": b"2"})
+    victim = mon.osdmap.object_to_acting("ecpool", "idx")[0]
+    mon.osd_down(victim)
+    mon.osd_out(victim)
+    wait_no_pg_temp(mon)
+    assert io.omap_get("idx") == {"a": b"1", "b": b"2"}
+    assert io.omap_list("idx") == [("a", b"1"), ("b", b"2")]
