@@ -13,7 +13,10 @@ import jax.numpy as jnp
 
 U64 = tuple  # (hi, lo) uint32 arrays
 
-_MASK16 = jnp.uint32(0xFFFF)
+# Plain python int: weak-typed under jnp ops, so no jax array (and hence
+# no backend initialization) is created at import time — the driver's
+# virtual-CPU-mesh dryrun depends on `import ceph_tpu` staying inert.
+_MASK16 = 0xFFFF
 
 
 def u64(hi, lo) -> U64:

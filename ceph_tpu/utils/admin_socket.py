@@ -6,9 +6,12 @@ socket (common/admin_socket.cc): ``perf dump``, ``config show``/
 commands. Here the same registry is an in-process command table (the
 transport is trivial to add; every consumer in-tree is in-process).
 
-Built-in commands are registered at import: perf/config/trace plus the
-ECInject operator surface (the qa suites drive injection exactly this
-way — qa/tasks/ceph_manager.py uses `ceph tell osd.N injectargs`).
+Built-in commands (perf/config/trace plus the ECInject operator
+surface — the qa suites drive injection exactly this way,
+qa/tasks/ceph_manager.py `ceph tell osd.N injectargs`) register
+lazily on first socket use so that importing ceph_tpu never touches
+jax: the driver's virtual-mesh dryrun must configure the backend
+before anything initializes it.
 """
 
 from __future__ import annotations
@@ -21,6 +24,21 @@ class AdminSocket:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._commands: dict[str, tuple[Callable[..., object], str]] = {}
+        self._builtin_lock = threading.Lock()
+        self._builtins_done = False
+
+    def _ensure_builtins(self) -> None:
+        # Builtins register on first use, not at import: the registration
+        # pulls in ceph_tpu.pipeline, and `import ceph_tpu` must stay free
+        # of jax backend initialization for the multichip dryrun. The
+        # dedicated lock makes concurrent first users wait for the full
+        # table; the flag flips only after success so a transient failure
+        # retries on the next call.
+        with self._builtin_lock:
+            if self._builtins_done:
+                return
+            _register_builtins(self)
+            self._builtins_done = True
 
     def register(self, command: str, fn: Callable[..., object], desc: str = "") -> None:
         with self._lock:
@@ -33,6 +51,7 @@ class AdminSocket:
             self._commands.pop(command, None)
 
     def execute(self, command: str, **kwargs):
+        self._ensure_builtins()
         with self._lock:
             entry = self._commands.get(command)
         if entry is None:
@@ -40,6 +59,7 @@ class AdminSocket:
         return entry[0](**kwargs)
 
     def help(self) -> dict[str, str]:
+        self._ensure_builtins()
         with self._lock:
             return {cmd: desc for cmd, (_, desc) in sorted(self._commands.items())}
 
@@ -47,72 +67,68 @@ class AdminSocket:
 admin_socket = AdminSocket()
 
 
-def _register_builtins() -> None:
+def _register_builtins(sock: AdminSocket) -> None:
     from ceph_tpu.utils.config import config
     from ceph_tpu.utils.perf_counters import perf_collection
     from ceph_tpu.utils.trace import tracer
 
-    admin_socket.register(
+    sock.register(
         "perf dump", lambda: perf_collection.dump(),
         "dump all perf counters",
     )
-    admin_socket.register(
+    sock.register(
         "config show", lambda: config.show(),
         "effective config values with their source layer",
     )
-    admin_socket.register(
+    sock.register(
         "config set",
         lambda name, value: (config.set(name, value), config.get(name))[1],
         "set a runtime config override",
     )
-    admin_socket.register(
+    sock.register(
         "config get", lambda name: config.get(name),
         "read one effective config value",
     )
-    admin_socket.register(
+    sock.register(
         "dump_historic_ops",
         lambda limit=None: tracer.dump_historic(limit),
         "recently completed trace spans",
     )
 
     def _inject(kind: str):
-        from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject
+        def run(oid, type, when=0, duration=1, shard=None):
+            from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject
 
-        fn = getattr(ec_inject, kind)
-
-        def run(oid, type, when=0, duration=1, shard=ANY_SHARD):
-            return fn(oid, int(type), when=int(when),
-                      duration=int(duration), shard=int(shard))
+            fn = getattr(ec_inject, kind)
+            return fn(oid, int(type), when=int(when), duration=int(duration),
+                      shard=ANY_SHARD if shard is None else int(shard))
 
         return run
 
-    admin_socket.register(
+    sock.register(
         "injectecreaderr", _inject("read_error"),
         "inject EC read errors (type 0=EIO, 1=missing)",
     )
-    admin_socket.register(
+    sock.register(
         "injectecwriteerr", _inject("write_error"),
         "inject EC write errors (type 0=abort, 1=dropped sub-write)",
     )
 
     def _clear(kind: str):
-        from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject
+        def run(oid, type, shard=None):
+            from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject
 
-        fn = getattr(ec_inject, kind)
-
-        def run(oid, type, shard=ANY_SHARD):
-            return fn(oid, int(type), shard=int(shard))
+            fn = getattr(ec_inject, kind)
+            return fn(oid, int(type),
+                      shard=ANY_SHARD if shard is None else int(shard))
 
         return run
 
-    admin_socket.register(
+    sock.register(
         "injectecclearreaderr", _clear("clear_read_error"),
         "clear injected EC read errors",
     )
-    admin_socket.register(
+    sock.register(
         "injectecclearwriteerr", _clear("clear_write_error"),
         "clear injected EC write errors",
     )
-
-
-_register_builtins()

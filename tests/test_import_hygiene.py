@@ -1,0 +1,43 @@
+"""Regression: importing ceph_tpu must not initialize a jax backend.
+
+Round-1 failure (MULTICHIP_r01.json ok=false): a module-import-time
+jax array (checksum/u64.py) plus eager admin-socket builtin
+registration initialized the default (TPU-tunnel) backend before the
+driver's dryrun could force a virtual CPU mesh. These subprocess
+checks pin the fix.
+"""
+
+import subprocess
+import sys
+
+_CHECK = """
+import ceph_tpu
+import ceph_tpu.checksum, ceph_tpu.codecs, ceph_tpu.cluster, ceph_tpu.msg
+import ceph_tpu.parallel, ceph_tpu.pipeline, ceph_tpu.store, ceph_tpu.utils
+import jax._src.xla_bridge as xb
+assert not xb._backends, f"backend initialized at import: {list(xb._backends)}"
+"""
+
+
+def _run(code: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True
+    )
+
+
+def test_import_initializes_no_backend():
+    proc = _run(_CHECK)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_admin_socket_first_use_still_works():
+    # Lazy builtin registration must still expose the command table.
+    proc = _run(
+        _CHECK
+        + """
+from ceph_tpu.utils import admin_socket
+assert "perf dump" in admin_socket.help()
+admin_socket.execute("config get", name="ec_use_pallas")
+"""
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -1,0 +1,146 @@
+"""Distributed tier tests: ceph_tpu.parallel on the virtual 8-CPU mesh.
+
+This is the shard fan-out that replaces the reference's
+MOSDECSubOpWrite all-to-all (src/messages/MOSDECSubOpWrite.h,
+src/msg/async/AsyncMessenger.h:95): stripes shard over ``dp``, the
+shard axis over ``sp``, and parity combines with an XOR-allreduce
+(psum of bit counts mod 2). Every result is checked against the host
+GF oracle — the same vouching the reference's non-regression corpus
+provides for its SIMD kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ceph_tpu.checksum.reference import crc32c_ref
+from ceph_tpu.gf import (
+    decode_matrix,
+    gf_matmul_np,
+    gf_matrix_to_bitmatrix,
+    vandermonde_rs_matrix,
+)
+from ceph_tpu.parallel import (
+    make_ec_mesh,
+    sharded_decode,
+    sharded_encode,
+    sharded_pipeline_step,
+)
+
+
+def _host_parity(g, k, data):
+    return np.stack([gf_matmul_np(g[k:, :], data[i]) for i in range(data.shape[0])])
+
+
+def _mk(rng, batch, k, n):
+    return rng.integers(0, 256, (batch, k, n)).astype(np.uint8)
+
+
+class TestMakeEcMesh:
+    def test_even_split_uses_both_axes(self):
+        mesh = make_ec_mesh(8, k=8)
+        assert mesh.shape == {"dp": 2, "sp": 4}
+
+    def test_sp_divides_k(self):
+        mesh = make_ec_mesh(8, k=4)
+        assert mesh.shape["sp"] in (1, 2, 4)
+        assert 4 % mesh.shape["sp"] == 0
+        assert mesh.shape["dp"] * mesh.shape["sp"] == 8
+
+    def test_odd_device_count(self):
+        # gcd path: only sp=1 divides both 5 and 8.
+        mesh = make_ec_mesh(5, k=8)
+        assert mesh.shape == {"dp": 5, "sp": 1}
+
+    def test_non_divisor_k(self):
+        mesh = make_ec_mesh(6, k=8)
+        assert mesh.shape == {"dp": 3, "sp": 2}
+
+    def test_requesting_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="requested"):
+            make_ec_mesh(len(jax.devices()) + 1)
+
+    def test_default_takes_all_devices(self):
+        mesh = make_ec_mesh(k=8)
+        assert mesh.shape["dp"] * mesh.shape["sp"] == len(jax.devices())
+
+
+class TestShardedEncode:
+    @pytest.mark.parametrize("n_dev,k,m", [(8, 8, 4), (8, 4, 2), (4, 8, 3), (2, 2, 2)])
+    def test_parity_matches_host_oracle(self, rng, n_dev, k, m):
+        g = vandermonde_rs_matrix(k, m)
+        bmat = jnp.asarray(gf_matrix_to_bitmatrix(g[k:, :]))
+        mesh = make_ec_mesh(n_dev, k=k)
+        batch = 2 * mesh.shape["dp"]
+        data = _mk(rng, batch, k, 512)
+        parity = np.asarray(sharded_encode(mesh, bmat, jnp.asarray(data)))
+        assert (parity == _host_parity(g, k, data)).all()
+
+    def test_sp1_mesh_pure_dp(self, rng):
+        # Degenerate sp=1: the psum collapses to identity; parity must
+        # still be exact (covers odd meshes where only dp is active).
+        g = vandermonde_rs_matrix(8, 4)
+        bmat = jnp.asarray(gf_matrix_to_bitmatrix(g[8:, :]))
+        mesh = make_ec_mesh(5, k=8)
+        data = _mk(rng, 5, 8, 256)
+        parity = np.asarray(sharded_encode(mesh, bmat, jnp.asarray(data)))
+        assert (parity == _host_parity(g, 8, data)).all()
+
+    def test_jit_under_mesh(self, rng):
+        g = vandermonde_rs_matrix(8, 4)
+        bmat = jnp.asarray(gf_matrix_to_bitmatrix(g[8:, :]))
+        mesh = make_ec_mesh(8, k=8)
+        data = _mk(rng, 4, 8, 256)
+        fn = jax.jit(lambda b, d: sharded_encode(mesh, b, d))
+        parity = np.asarray(fn(bmat, jnp.asarray(data)))
+        assert (parity == _host_parity(g, 8, data)).all()
+
+
+class TestShardedDecode:
+    @pytest.mark.parametrize("lost", [[0], [0, 1], [3, 9], [10, 11]])
+    def test_reconstruct_vs_oracle(self, rng, lost):
+        k, m = 8, 4
+        g = vandermonde_rs_matrix(k, m)
+        mesh = make_ec_mesh(8, k=k)
+        batch = 2 * mesh.shape["dp"]
+        data = _mk(rng, batch, k, 512)
+        parity = _host_parity(g, k, data)
+        chunks = np.concatenate([data, parity], axis=1)
+
+        present = [i for i in range(k + m) if i not in lost][:k]
+        d = decode_matrix(g, k, present)
+        want_rows = [i for i in lost if i < k]
+        if not want_rows:  # parity-only loss: re-encode from decoded data
+            want_rows = list(range(k))
+        dec_bmat = jnp.asarray(gf_matrix_to_bitmatrix(d[want_rows, :]))
+        survivors = jnp.asarray(chunks[:, present, :])
+        rec = np.asarray(sharded_decode(mesh, dec_bmat, survivors))
+        assert (rec == chunks[:, want_rows, :]).all()
+
+
+class TestShardedPipelineStep:
+    def test_parity_and_csum(self, rng):
+        k, m = 8, 4
+        g = vandermonde_rs_matrix(k, m)
+        bmat = jnp.asarray(gf_matrix_to_bitmatrix(g[k:, :]))
+        mesh = make_ec_mesh(8, k=k)
+        batch = 2 * mesh.shape["dp"]
+        data = _mk(rng, batch, k, 256)
+        out = jax.jit(lambda b, d: sharded_pipeline_step(mesh, b, d))(
+            bmat, jnp.asarray(data)
+        )
+        parity = np.asarray(out["parity"])
+        assert (parity == _host_parity(g, k, data)).all()
+        csum = np.asarray(out["csum"])
+        for b in range(batch):
+            for j in range(m):
+                assert csum[b, j] == crc32c_ref(0xFFFFFFFF, parity[b, j].tobytes())
+
+
+def test_graft_entry_dryrun_inprocess():
+    # The driver-facing deliverable itself: must pass on this
+    # already-initialized 8-device CPU backend without re-exec.
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
