@@ -55,6 +55,14 @@ class Objecter:
         self.messenger.set_dispatcher(self._dispatch)
         self._conns: dict[tuple[str, int], Connection] = {}
         self._tids = itertools.count(1)
+        # osd_reqid_t analog: (client instance, seq) names a LOGICAL op
+        # across resends, so a primary that already applied an attempt
+        # whose reply was lost replays the result instead of
+        # re-applying (the reference dedups via pg-log reqids).
+        import uuid
+
+        self.client_id = uuid.uuid4().hex[:12]
+        self._reqs = itertools.count(1)
         self._lock = threading.Lock()
         self._waiting: dict[int, dict] = {}  # tid -> {event, reply}
         self._aio_executor = None
@@ -93,6 +101,11 @@ class Objecter:
         name: str = "",
     ) -> OSDOpReply:
         last = "no attempt made"
+        reqid = f"{self.client_id}.{next(self._reqs)}"
+        # True once an attempt's outcome is unknown (timeout or lost
+        # connection after send): the op may have applied without us
+        # seeing the reply.
+        ambiguous = False
         for attempt in range(self.max_attempts):
             if attempt:
                 self.resends += 1
@@ -119,13 +132,15 @@ class Objecter:
             try:
                 self._conn(addr).send(
                     OSDOp(tid, osdmap.epoch, pool, oid, op,
-                          offset, length, data, name)
+                          offset, length, data, name, reqid=reqid)
                 )
                 if not entry["event"].wait(self.op_timeout):
                     last = f"osd.{primary} timed out"
+                    ambiguous = True
                     continue
             except (ConnectionError, OSError):
                 last = f"osd.{primary} connection failed"
+                ambiguous = True  # the send may still have landed
                 with self._lock:
                     self._conns.pop(addr, None)
                 continue
@@ -137,6 +152,15 @@ class Objecter:
                 last = f"osd.{primary} not primary (its epoch {reply.epoch})"
                 continue
             if reply.error == "enoent":
+                if op == "remove" and ambiguous:
+                    # The reqid dedup cache is primary-local; after a
+                    # failover the new primary cannot replay the lost
+                    # reply. When an earlier attempt's outcome is
+                    # unknown, enoent on the resent remove means it
+                    # already applied — the object is gone, which is
+                    # what the caller asked for. (eagain-only retries
+                    # stay unambiguous and surface enoent normally.)
+                    return reply
                 raise FileNotFoundError(f"{pool}/{oid}")
             if reply.error == "enodata":
                 raise KeyError(f"{pool}/{oid}: no such xattr")

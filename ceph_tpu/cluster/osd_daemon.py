@@ -32,6 +32,7 @@ Peer-failure evidence flows to the monitor via ``report_failure``.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 
 from ceph_tpu.msg.messages import (
     ECSubRead,
@@ -65,6 +66,11 @@ from ceph_tpu.store import MemStore, Transaction
 from ceph_tpu.utils.mclock import MClockScheduler
 
 from .osdmap import OSDMap, SHARD_NONE
+
+#: ops whose re-application a lost-reply resend must not repeat
+_MUTATING_OPS = frozenset(
+    {"write", "remove", "setxattr", "rmxattr", "omapset"}
+)
 
 
 def make_loc(pool_id: int, oid: str) -> str:
@@ -305,6 +311,13 @@ class OSDDaemon:
         self._worker: threading.Thread | None = None
         self._op_lock = threading.Lock()   # serializes client ops
         self._pg_lock = threading.Lock()   # guards _pgs + peer addrs
+        # Completed-mutation results by client reqid (pg-log reqid
+        # dedup analog): a resend whose first attempt applied but whose
+        # reply was lost replays the recorded outcome instead of
+        # re-applying (remove would otherwise surface enoent for a
+        # successful op). Bounded FIFO; guarded by _op_lock.
+        self._completed_ops: "OrderedDict[str, OSDOpReply]" = OrderedDict()
+        self._completed_cap = 1024
         self._stopped = False
 
     # -- lifecycle ------------------------------------------------------
@@ -581,17 +594,38 @@ class OSDDaemon:
                         loc, {shard}, size=size_hint
                     )
                 pg.born_holes.discard(shard)
+            def _dirty() -> bool:
+                return bool(
+                    pg.pglog.dirty_extents(shard)
+                    or pg.pglog.dirty_deletes(shard)
+                    or pg.pglog.dirty_xattrs(shard)
+                )
+
             for _ in range(8):
                 self.admit("recovery")
                 pg.recovery.recover_from_log(pg.pglog, shard)
-                if (
-                    not pg.pglog.dirty_extents(shard)
-                    and not pg.pglog.dirty_deletes(shard)
-                    and not pg.pglog.dirty_xattrs(shard)
-                ):
+                if not _dirty():
                     break
-            pg.backend.recovering.discard(shard)
-            pg.rmw.on_shard_recovered(shard)
+            # Admission happens under the op lock with a final clean
+            # check: client writes (which also take _op_lock) cannot
+            # append dirty entries between the check and the admit, so
+            # a still-behind shard can never enter the read set and
+            # serve stale bytes into EC decode. If the retry budget
+            # ran out, one more replay runs here race-free — WITHOUT
+            # QoS admission: admit() grants fire on the worker thread,
+            # which may itself be blocked on _op_lock (the backfill
+            # final pass skips admission under the lock for the same
+            # reason). A shard dirty even then reverts to a hole
+            # (except path below).
+            with self._op_lock:
+                if _dirty():
+                    pg.recovery.recover_from_log(pg.pglog, shard)
+                if _dirty():
+                    raise RuntimeError(
+                        f"shard {shard} still dirty after replay budget"
+                    )
+                pg.backend.recovering.discard(shard)
+                pg.rmw.on_shard_recovered(shard)
         except Exception:
             with self._pg_lock:
                 pg.acting[shard] = SHARD_NONE
@@ -780,9 +814,16 @@ class OSDDaemon:
         pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
         msg.oid = make_loc(spec.pool_id, msg.oid)  # pool-scoped store key
         with self._op_lock:
+            if msg.op in _MUTATING_OPS and msg.reqid:
+                cached = self._completed_ops.get(msg.reqid)
+                if cached is not None:
+                    return OSDOpReply(
+                        msg.tid, epoch, error=cached.error,
+                        size=cached.size, data=cached.data,
+                    )
             pg = self._get_pg(msg.pool, pgid)
             if msg.op == "write":
-                return self._op_write(pg, msg)
+                return self._record_completed(msg, self._op_write(pg, msg))
             if msg.op == "read":
                 return self._op_read(pg, msg)
             if msg.op == "stat":
@@ -791,21 +832,31 @@ class OSDDaemon:
                 size = self._object_size(pg, msg.oid)
                 return OSDOpReply(msg.tid, epoch, size=size)
             if msg.op == "remove":
-                return self._op_remove(pg, msg)
+                return self._record_completed(msg, self._op_remove(pg, msg))
             if msg.op in ("setxattr", "rmxattr"):
-                return self._op_setxattr(pg, msg)
+                return self._record_completed(msg, self._op_setxattr(pg, msg))
             if msg.op == "getxattr":
                 return self._op_getxattr(pg, msg)
             if msg.op == "getxattrs":
                 return self._op_getxattrs(pg, msg)
             if msg.op == "omapset":
-                return self._op_omapset(pg, msg)
+                return self._record_completed(msg, self._op_omapset(pg, msg))
             if msg.op == "omapget":
                 return self._op_omapget(pg, msg)
             if msg.op == "omaplist":
                 return self._op_omaplist(pg, msg)
             return OSDOpReply(msg.tid, epoch, error="eio",
                               data=f"bad op {msg.op!r}".encode())
+
+    def _record_completed(self, msg: OSDOp, reply: OSDOpReply) -> OSDOpReply:
+        """Remember a mutation's outcome under its client reqid so a
+        resend (lost reply) replays the result instead of re-applying.
+        Caller holds _op_lock."""
+        if msg.reqid:
+            self._completed_ops[msg.reqid] = reply
+            while len(self._completed_ops) > self._completed_cap:
+                self._completed_ops.popitem(last=False)
+        return reply
 
     def _op_write(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
         self._object_size(pg, msg.oid)  # prime from attrs on takeover

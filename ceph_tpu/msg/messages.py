@@ -223,6 +223,9 @@ class OSDOp:
     length: int = 0
     data: bytes = b""
     name: str = ""  # xattr name for the *xattr ops
+    #: stable across resends (osd_reqid_t analog): the primary dedups
+    #: re-applied mutations by replaying the completed op's result
+    reqid: str = ""
 
     def encode(self) -> list[bytes]:
         return [
@@ -237,6 +240,7 @@ class OSDOp:
                     "offset": self.offset,
                     "length": self.length,
                     "name": self.name,
+                    "reqid": self.reqid,
                 },
             ),
             self.data,
@@ -248,6 +252,7 @@ class OSDOp:
         return cls(
             h["tid"], h["epoch"], h["pool"], h["oid"], h["op"],
             h["offset"], h["length"], segments[1], h.get("name", ""),
+            h.get("reqid", ""),
         )
 
 

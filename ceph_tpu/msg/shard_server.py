@@ -137,6 +137,13 @@ class NetShardBackend:
         self._lock = threading.Lock()
         self._waiting: dict[tuple[int, int], _Pending] = {}
         self._inbox: "queue.Queue[Callable[[], None]]" = queue.Queue()
+        # Serializes reply-callback execution (and predicate checks)
+        # across concurrent drainers: client-op workers, backfill and
+        # catch-up recovery threads all drain the one inbox, and the
+        # RMW/read pipelines assume their callbacks never run
+        # concurrently (crimson run-to-completion stance). RLock: a
+        # callback may itself drain (sync read inside a recovery step).
+        self._cb_lock = threading.RLock()
         self._last_seen: dict[int, float] = {}
         self._hb_stop: threading.Event | None = None
         self._hb_thread: threading.Thread | None = None
@@ -210,9 +217,16 @@ class NetShardBackend:
         self, pred: Callable[[], bool], timeout: float = 30.0
     ) -> None:
         """Run queued reply callbacks on this thread until ``pred``
-        holds. Raises TimeoutError if it never does."""
+        holds. Raises TimeoutError if it never does. Any thread may
+        drain; pipeline callbacks stay mutually serialized under
+        ``_cb_lock`` (a drainer may execute another waiter's thunk —
+        the state change it was waiting on is shared, so its own
+        predicate pass sees it)."""
         end = time.monotonic() + timeout
-        while not pred():
+        while True:
+            with self._cb_lock:
+                if pred():
+                    return
             self._expire()
             try:
                 thunk = self._inbox.get(timeout=0.05)
@@ -220,7 +234,8 @@ class NetShardBackend:
                 if time.monotonic() > end:
                     raise TimeoutError("drain_until: condition never held")
                 continue
-            thunk()
+            with self._cb_lock:
+                thunk()
 
     # -- ShardBackend surface ------------------------------------------
     def set_addr(self, shard: int, addr: tuple[str, int]) -> None:

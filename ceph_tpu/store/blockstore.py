@@ -161,7 +161,18 @@ class BlockStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.ckpt_path)
-        open(self.wal_path, "wb").close()  # WAL fully absorbed
+        # Durability ordering (the FileStore.queue_transactions
+        # discipline): the rename must be on disk BEFORE the WAL
+        # truncate is, else a power cut can keep the truncate but not
+        # the rename and lose acked transactions on reopen.
+        dirfd = os.open(os.path.dirname(self.ckpt_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        with open(self.wal_path, "wb") as wal:
+            wal.flush()
+            os.fsync(wal.fileno())  # WAL fully absorbed
         self._wal_records = 0
 
     def _commit_metadata(self) -> None:

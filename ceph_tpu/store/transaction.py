@@ -85,7 +85,22 @@ class Transaction:
         return self
 
     # -- wire serialization (Transaction::encode/decode analog) --------
-    _KIND_CODE = {k: i for i, k in enumerate(OpKind)}
+    # Explicit stable codes, independent of OpKind declaration order:
+    # these live in persisted FileStore journals and ECSubWrite
+    # payloads, so renumbering silently corrupts replay. New kinds
+    # append new codes; never reuse one.
+    _KIND_CODE = {
+        OpKind.TOUCH: 0,
+        OpKind.WRITE: 1,
+        OpKind.ZERO: 2,
+        OpKind.TRUNCATE: 3,
+        OpKind.REMOVE: 4,
+        OpKind.SETATTR: 5,
+        OpKind.RMATTR: 6,
+        OpKind.RMATTR_TOLERANT: 7,
+    }
+    assert len(_KIND_CODE) == len(OpKind), "every OpKind needs a wire code"
+    assert len(set(_KIND_CODE.values())) == len(_KIND_CODE), "codes must be unique"
 
     def to_bytes(self) -> bytes:
         """Compact binary encoding for ECSubWrite payloads: version

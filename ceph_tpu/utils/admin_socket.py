@@ -24,29 +24,39 @@ class AdminSocket:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._commands: dict[str, tuple[Callable[..., object], str]] = {}
-        self._builtin_lock = threading.Lock()
+        self._builtin_lock = threading.RLock()
         self._builtins_done = False
+        self._builtins_registering = False
 
     def _ensure_builtins(self) -> None:
         # Builtins register on first use, not at import: the registration
         # pulls in ceph_tpu.pipeline, and `import ceph_tpu` must stay free
         # of jax backend initialization for the multichip dryrun. The
-        # dedicated lock makes concurrent first users wait for the full
-        # table; the flag flips only after success so a transient failure
+        # dedicated RLock makes concurrent first users wait for the full
+        # table while the builtins' own register() calls re-enter; the
+        # done-flag flips only after success so a transient failure
         # retries on the next call.
         with self._builtin_lock:
-            if self._builtins_done:
+            if self._builtins_done or self._builtins_registering:
                 return
-            _register_builtins(self)
-            self._builtins_done = True
+            self._builtins_registering = True
+            try:
+                _register_builtins(self)
+                self._builtins_done = True
+            finally:
+                self._builtins_registering = False
 
     def register(self, command: str, fn: Callable[..., object], desc: str = "") -> None:
+        self._ensure_builtins()
         with self._lock:
             if command in self._commands:
                 raise ValueError(f"command {command!r} already registered")
             self._commands[command] = (fn, desc)
 
     def unregister(self, command: str) -> None:
+        # Builtins load first so an unregister sticks: a later first
+        # execute() must not resurrect what the caller removed.
+        self._ensure_builtins()
         with self._lock:
             self._commands.pop(command, None)
 

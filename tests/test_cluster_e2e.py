@@ -461,3 +461,52 @@ def test_omap_surface(cluster):
     assert "m:k002" not in attrs
     with pytest.raises(FileNotFoundError):
         io.omap_get("ghost")
+
+
+def test_resent_remove_replays_cached_result(cluster):
+    """Lost-reply resend semantics (pg-log reqid dedup analog): a
+    remove whose first attempt applied but whose reply was lost must
+    NOT surface enoent when retried under the same reqid — and a
+    resent write must not re-apply."""
+    from ceph_tpu.msg.messages import OSDOp
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("victim", payload(3_000))
+
+    # Find the primary and replay the same logical op twice, as the
+    # objecter's resend path would after a reply loss.
+    primary = mon.osdmap.primary("ecpool", "victim")
+    d = next(dd for dd in daemons if dd.osd_id == primary)
+    op1 = OSDOp(901, mon.osdmap.epoch, "ecpool", "victim", "remove",
+                reqid="clientX.1")
+    r1 = d._execute_client_op(op1)
+    assert r1.error == ""
+    op2 = OSDOp(902, mon.osdmap.epoch, "ecpool", "victim", "remove",
+                reqid="clientX.1")
+    r2 = d._execute_client_op(op2)
+    assert r2.error == "", "resent remove must replay success, not enoent"
+
+    # A NEW logical remove (fresh reqid) now correctly sees enoent.
+    op3 = OSDOp(903, mon.osdmap.epoch, "ecpool", "victim", "remove",
+                reqid="clientX.2")
+    assert d._execute_client_op(op3).error == "enoent"
+
+    # Write resend: the replay returns the recorded result and does
+    # NOT re-apply. Sequence: write A (reqid W1), then write B (fresh
+    # reqid) over it, then resend W1 — content must stay B.
+    a, b = payload(2_000, seed=10), payload(2_000, seed=11)
+    primary_w = mon.osdmap.primary("ecpool", "wobj")
+    dw = next(dd for dd in daemons if dd.osd_id == primary_w)
+    w1 = OSDOp(910, mon.osdmap.epoch, "ecpool", "wobj", "write",
+               data=a, reqid="clientX.w1")
+    r_w1 = dw._execute_client_op(w1)
+    assert r_w1.error == "" and r_w1.size == 2_000
+    w2 = OSDOp(911, mon.osdmap.epoch, "ecpool", "wobj", "write",
+               data=b, reqid="clientX.w2")
+    assert dw._execute_client_op(w2).error == ""
+    w1_again = OSDOp(912, mon.osdmap.epoch, "ecpool", "wobj", "write",
+                     data=a, reqid="clientX.w1")
+    r_replay = dw._execute_client_op(w1_again)
+    assert r_replay.error == "" and r_replay.size == r_w1.size
+    assert io.read("wobj") == b, "resent write must not re-apply"
