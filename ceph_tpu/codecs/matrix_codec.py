@@ -13,6 +13,7 @@ precedent (isa/ErasureCodeIsaTableCache.cc; SURVEY.md section 7
 
 from __future__ import annotations
 
+import functools
 from collections import OrderedDict
 
 import jax
@@ -34,22 +35,50 @@ def _apply_bitmatrix(bmat: jax.Array, shards: jax.Array) -> jax.Array:
     return gf_encode_bitplane(bmat, shards)
 
 
+@functools.lru_cache(maxsize=1)
+def _dispatch_counters():
+    """Kernel-path visibility: which engine served each bit-matrix
+    application (Pallas MXU kernel / XLA einsum / host GF tables) and
+    how often an enabled Pallas path had to fall back on an
+    untileable shape. Served by ``perf dump`` as ``ec_dispatch``."""
+    from ceph_tpu.utils.perf_counters import (
+        PerfCountersBuilder,
+        perf_collection,
+    )
+
+    b = PerfCountersBuilder(perf_collection, "ec_dispatch")
+    for op in ("encode", "decode", "delta"):
+        b.add_u64_counter(f"pallas_{op}", f"{op}s served by the Pallas kernel")
+        b.add_u64_counter(f"einsum_{op}", f"{op}s served by the einsum engine")
+        b.add_u64_counter(f"host_{op}", f"{op}s served by host GF tables")
+    b.add_u64_counter(
+        "pallas_fallback",
+        "dispatches where Pallas was enabled on TPU but the shape "
+        "could not tile (chunk axis % LANE_TILE != 0)",
+    )
+    return b.create_perf_counters()
+
+
 class DecodeTableCache:
     """LRU of device bit-matrices keyed by (present-shards, wanted-shards).
 
     The ISA plugin caches inverted decode tables because inversion is the
     sequential hot-path cost under churny erasure patterns
     (ErasureCodeIsaTableCache.cc, 327 LoC). Same idea; the cached value
-    here is the expanded GF(2) matrix already on device.
+    here is the expanded GF(2) matrix, host-side and on device (both
+    forms: the Pallas kernel folds the host copy, einsum uses the
+    device copy).
     """
 
     def __init__(self, maxsize: int = 256) -> None:
         self.maxsize = maxsize
-        self._cache: OrderedDict[tuple, jax.Array] = OrderedDict()
+        # Values are whatever the builder returns — (np bitmatrix,
+        # device bitmatrix) pairs here; codecs may cache richer tuples.
+        self._cache: OrderedDict[tuple, object] = OrderedDict()
         self.hits = 0
         self.misses = 0
 
-    def get(self, key: tuple, build) -> jax.Array:
+    def get(self, key: tuple, build):
         if key in self._cache:
             self._cache.move_to_end(key)
             self.hits += 1
@@ -118,28 +147,41 @@ class MatrixErasureCodec(ErasureCodeBase):
         """Dispatch the parity matmul: host GF tables for small numpy
         inputs, the fused Pallas MXU kernel on TPU when the shape
         tiles (config-gated), einsum otherwise."""
-        from ceph_tpu.ops import pallas_encode as pe
-        from ceph_tpu.utils import config
-
         if self._host_sized(stacked):
             from ceph_tpu.gf import gf_apply_bytes_host
 
+            _dispatch_counters().inc("host_encode")
             return gf_apply_bytes_host(
                 self.generator[self.k :, :], stacked
             )
-        lead = stacked.shape[:-2]
-        flat_shape = (-1,) + stacked.shape[-2:]
-        if (
-            config.get("ec_use_pallas")
-            and pe.on_tpu()
-            and pe.supported((1,) + stacked.shape[-2:])
-        ):
-            flat = stacked.reshape(flat_shape)
-            parity = pe.gf_encode_bitplane_pallas(
-                self._encode_bmat_np, flat
-            )
-            return parity.reshape(lead + parity.shape[-2:])
-        return _apply_bitmatrix(self._encode_bmat, stacked)
+        return self._dispatch_bitmatrix(
+            self._encode_bmat_np, self._encode_bmat, stacked, "encode"
+        )
+
+    def _dispatch_bitmatrix(
+        self,
+        bmat_np: np.ndarray,
+        bmat_dev: jax.Array,
+        stacked: jax.Array,
+        op: str,
+    ) -> jax.Array:
+        """Route one device bit-matrix application. Decode and delta
+        ride the same fused kernel as encode — the kernel is generic
+        over [R*8, C*8] bitmatrices, so reconstruct is a first-class
+        on-chip path (the reference treats decode as equally hot:
+        osd/ECUtil.cc:648-729, isa/ErasureCodeIsa.cc:504-516)."""
+        from ceph_tpu.ops import pallas_encode as pe
+        from ceph_tpu.utils import config
+
+        if config.get("ec_use_pallas") and pe.on_tpu():
+            if pe.supported((1,) + stacked.shape[-2:]):
+                _dispatch_counters().inc(f"pallas_{op}")
+                flat = stacked.reshape((-1,) + stacked.shape[-2:])
+                out = pe.gf_encode_bitplane_pallas(bmat_np, flat)
+                return out.reshape(stacked.shape[:-2] + out.shape[-2:])
+            _dispatch_counters().inc("pallas_fallback")
+        _dispatch_counters().inc(f"einsum_{op}")
+        return _apply_bitmatrix(bmat_dev, stacked)
 
     # -- decode -------------------------------------------------------
     def decode_chunks(
@@ -161,16 +203,19 @@ class MatrixErasureCodec(ErasureCodeBase):
         ) and self._host_sized(*vals):
             from ceph_tpu.gf import gf_apply_bytes_host
 
+            _dispatch_counters().inc("host_decode")
             mat = self._host_tables.get(
                 key, lambda: self._build_decode_bytes(present, want)
             )
             out = gf_apply_bytes_host(mat, np.stack(vals, axis=-2))
         else:
-            bmat = self._tables.get(
+            bmat_np, bmat_dev = self._tables.get(
                 key, lambda: self._build_decode_bmat(present, want)
             )
             stacked = jnp.stack(vals, axis=-2)
-            out = _apply_bitmatrix(bmat, stacked)
+            out = self._dispatch_bitmatrix(
+                bmat_np, bmat_dev, stacked, "decode"
+            )
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, w in enumerate(want):
             result[w] = out[..., idx, :]
@@ -197,12 +242,9 @@ class MatrixErasureCodec(ErasureCodeBase):
 
     def _build_decode_bmat(
         self, present: list[int], want: list[int]
-    ) -> jax.Array:
-        return jnp.asarray(
-            gf_matrix_to_bitmatrix(
-                self._build_decode_bytes(present, want)
-            )
-        )
+    ) -> tuple[np.ndarray, jax.Array]:
+        bm = gf_matrix_to_bitmatrix(self._build_decode_bytes(present, want))
+        return bm, jnp.asarray(bm)
 
     # -- parity delta (RMW) -------------------------------------------
     def encode_delta(
@@ -227,6 +269,7 @@ class MatrixErasureCodec(ErasureCodeBase):
         ):
             from ceph_tpu.gf import gf_apply_bytes_host
 
+            _dispatch_counters().inc("host_delta")
             contrib = gf_apply_bytes_host(
                 self.generator[self.k :, cols], np.stack(vals, axis=-2)
             )
@@ -236,14 +279,18 @@ class MatrixErasureCodec(ErasureCodeBase):
                 )
                 for pid, p in parity.items()
             }
-        bmat = self._tables.get(
-            ("delta", tuple(cols)),
-            lambda: jnp.asarray(
-                gf_matrix_to_bitmatrix(self.generator[self.k :, cols])
-            ),
+
+        def _build_delta():
+            bm = gf_matrix_to_bitmatrix(self.generator[self.k :, cols])
+            return bm, jnp.asarray(bm)
+
+        bmat_np, bmat_dev = self._tables.get(
+            ("delta", tuple(cols)), _build_delta
         )
         stacked = jnp.stack(vals, axis=-2)
-        contrib = _apply_bitmatrix(bmat, stacked)
+        contrib = self._dispatch_bitmatrix(
+            bmat_np, bmat_dev, stacked, "delta"
+        )
         return {
             pid: xor_bytes(p, contrib[..., pid - self.k, :])
             for pid, p in parity.items()

@@ -36,7 +36,7 @@ from ceph_tpu.gf import (
 
 from .base import to_int
 from .interface import ErasureCodeProfile, Flag, SubChunkPlan
-from .matrix_codec import MatrixErasureCodec, _apply_bitmatrix
+from .matrix_codec import MatrixErasureCodec
 from .registry import registry
 
 
@@ -274,9 +274,9 @@ class ShecCodec(MatrixErasureCodec):
         inputs_rows = self._tables.get(
             key, lambda: self._build_reconstruction(set(chunks), missing)
         )
-        inputs, bmat = inputs_rows
+        inputs, bmat_np, bmat_dev = inputs_rows
         stacked = jnp.stack([chunks[i] for i in inputs], axis=-2)
-        out = _apply_bitmatrix(bmat, stacked)
+        out = self._dispatch_bitmatrix(bmat_np, bmat_dev, stacked, "decode")
         result = {s: chunks[s] for s in want_to_read if s in chunks}
         for idx, s in enumerate(missing):
             result[s] = out[..., idx, :]
@@ -284,7 +284,7 @@ class ShecCodec(MatrixErasureCodec):
 
     def _build_reconstruction(
         self, available: set[int], missing: list[int]
-    ) -> tuple[list[int], jax.Array]:
+    ) -> tuple[list[int], np.ndarray, jax.Array]:
         """One GF matrix mapping survivor chunks -> all missing wanted
         shards: erased data via the inverted shingle system, erased
         parity re-encoded by composition (shec_matrix_decode)."""
@@ -338,8 +338,8 @@ class ShecCodec(MatrixErasureCodec):
                         contrib[None, :],
                     )[0]
                 out_rows.append(vec)
-        bmat = jnp.asarray(gf_matrix_to_bitmatrix(np.stack(out_rows)))
-        return inputs, bmat
+        bm = gf_matrix_to_bitmatrix(np.stack(out_rows))
+        return inputs, bm, jnp.asarray(bm)
 
 
 registry.register("shec", ShecCodec, PLUGIN_ABI_VERSION)

@@ -234,8 +234,19 @@ class NetShardBackend:
                 if time.monotonic() > end:
                     raise TimeoutError("drain_until: condition never held")
                 continue
-            with self._cb_lock:
-                thunk()
+            # Execute only if no other thread is mid-callback: blocking
+            # here would park this thunk — possibly the very reply the
+            # lock holder's nested drain is waiting on — on our stack
+            # and starve it into a spurious TimeoutError. Re-queue and
+            # let the holder's own (re-entrant) drain loop pop it.
+            if self._cb_lock.acquire(blocking=False):
+                try:
+                    thunk()
+                finally:
+                    self._cb_lock.release()
+            else:
+                self._inbox.put(thunk)
+                time.sleep(0.001)
 
     # -- ShardBackend surface ------------------------------------------
     def set_addr(self, shard: int, addr: tuple[str, int]) -> None:

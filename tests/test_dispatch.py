@@ -1,0 +1,128 @@
+"""Kernel-path dispatch: decode/delta ride the Pallas kernel when it
+applies, the einsum engine otherwise, host GF tables for small numpy
+inputs — and every route is visible in the ``ec_dispatch`` perf
+counters (VERDICT r1: silent fallback must not exist).
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs.matrix_codec import _dispatch_counters
+from ceph_tpu.codecs.registry import registry
+from ceph_tpu.ops import pallas_encode as pe
+from ceph_tpu.ops.pallas_encode import LANE_TILE
+
+
+def _snap():
+    pc = _dispatch_counters()
+    return {k: pc.get(k) for k in pc.dump()}
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in after if after[k] != before[k]}
+
+
+@pytest.fixture
+def isa_codec():
+    codec = registry.factory("isa", {"k": "4", "m": "2"})
+    return codec
+
+
+def _device_chunks(rng, codec, n):
+    import jax.numpy as jnp
+
+    data = {
+        i: jnp.asarray(rng.integers(0, 256, (n,), np.uint8))
+        for i in range(codec.k)
+    }
+    return data
+
+
+def test_einsum_paths_counted(rng, isa_codec):
+    before = _snap()
+    data = _device_chunks(rng, isa_codec, 4096)
+    parity = isa_codec.encode_chunks(data)
+    chunks = dict(data) | parity
+    del chunks[0], chunks[5]
+    out = isa_codec.decode_chunks({0, 5}, chunks)
+    d = _delta(before, _snap())
+    assert d.get("einsum_encode", 0) >= 1
+    assert d.get("einsum_decode", 0) >= 1
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(data[0]))
+
+
+def test_host_paths_counted(rng, isa_codec):
+    before = _snap()
+    data = {i: rng.integers(0, 256, (512,), np.uint8) for i in range(4)}
+    parity = isa_codec.encode_chunks(data)
+    assert all(isinstance(p, np.ndarray) for p in parity.values())
+    chunks = dict(data) | parity
+    del chunks[1]
+    isa_codec.decode_chunks({1}, chunks)
+    d = _delta(before, _snap())
+    assert d.get("host_encode", 0) >= 1
+    assert d.get("host_decode", 0) >= 1
+
+
+def test_pallas_fallback_counted(rng, isa_codec, monkeypatch):
+    """Pallas enabled + on TPU + untileable shape -> fallback counter
+    ticks and the einsum engine serves the op (no silent drop)."""
+    monkeypatch.setattr(pe, "on_tpu", lambda: True)
+    before = _snap()
+    data = _device_chunks(rng, isa_codec, LANE_TILE + 256)
+    isa_codec.encode_chunks(data)
+    d = _delta(before, _snap())
+    assert d.get("pallas_fallback", 0) >= 1
+    assert d.get("einsum_encode", 0) >= 1
+
+
+def test_pallas_decode_path(rng, isa_codec, monkeypatch):
+    """With the TPU predicate forced on (kernel in interpreter mode so
+    CPU CI runs it), decode routes through the Pallas kernel and is
+    bit-exact vs the original data."""
+    monkeypatch.setattr(pe, "on_tpu", lambda: True)
+    monkeypatch.setattr(
+        pe,
+        "gf_encode_bitplane_pallas",
+        functools.partial(pe.gf_encode_bitplane_pallas, interpret=True),
+    )
+    before = _snap()
+    data = _device_chunks(rng, isa_codec, LANE_TILE)
+    parity = isa_codec.encode_chunks(data)
+    chunks = dict(data) | parity
+    del chunks[2], chunks[4]
+    out = isa_codec.decode_chunks({2, 4}, chunks)
+    d = _delta(before, _snap())
+    assert d.get("pallas_encode", 0) >= 1
+    assert d.get("pallas_decode", 0) >= 1
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(data[2]))
+
+
+def test_pallas_delta_path(rng, isa_codec, monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(pe, "on_tpu", lambda: True)
+    monkeypatch.setattr(
+        pe,
+        "gf_encode_bitplane_pallas",
+        functools.partial(pe.gf_encode_bitplane_pallas, interpret=True),
+    )
+    data = _device_chunks(rng, isa_codec, LANE_TILE)
+    parity = isa_codec.encode_chunks(data)
+    new0 = jnp.asarray(
+        rng.integers(0, 256, (LANE_TILE,), np.uint8)
+    )
+    before = _snap()
+    delta = {0: isa_codec.encode_delta(data[0], new0)}
+    updated = isa_codec.apply_delta(delta, parity)
+    d = _delta(before, _snap())
+    assert d.get("pallas_delta", 0) >= 1
+    # parity after delta == parity of the updated data
+    data2 = dict(data) | {0: new0}
+    fresh = isa_codec.encode_chunks(data2)
+    for pid in parity:
+        np.testing.assert_array_equal(
+            np.asarray(updated[pid]), np.asarray(fresh[pid])
+        )
