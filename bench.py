@@ -1,8 +1,20 @@
 """Flagship benchmark: EC(8,4) Reed-Solomon batched stripe encode.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline target: 25 GB/s/chip on TPU v5e-1 (BASELINE.json north star).
-``vs_baseline`` is the ratio value / 25.
+Prints ONE JSON line. Headline fields {"metric", "value", "unit",
+"vs_baseline"} report the encode throughput against the 25 GB/s/chip
+target (BASELINE.json north star); extra fields cover the rest of the
+BASELINE.md scorecard:
+
+  decode_gbps        on-chip reconstruct of 4 lost data shards from 8
+                     survivors (same bytes-in basis as encode)
+  vs_single_core     encode speedup over the native C single-core GF
+                     path (the ISA-L-role baseline, BASELINE.md target
+                     ">= 10x"); absent if the native lib is unavailable
+  hbm_gbps /         achieved HBM traffic (data-in + parity-out per
+  hbm_roofline_frac  encode) vs the ~819 GB/s v5e roofline
+  reconstruct_p50_ms / p99  single-chunk (64 KiB) reconstruct latency on
+                     the host small-op path (true per-op wall time — the
+                     low-latency path beside the bulk device path)
 
 Methodology — honest under the axon device tunnel, where
 ``block_until_ready`` resolves without waiting for remote execution
@@ -18,6 +30,10 @@ and any real sync costs a ~0.1-0.5 s round trip:
    counts: per_iter = (t(N2) - t(N1)) / (N2 - N1).
 4. A perturb-only loop measured the same way is subtracted so the
    reported number is the encode alone.
+5. Differenced estimates are noisy under tunnel-latency jitter — a
+   hiccup on the short trip makes a diff NEGATIVE. Each estimate is
+   the median of the positive diffs over several repeats (r1 took the
+   min, which once picked a glitch and printed 6.7e7 GB/s).
 
 The reference tool's spirit is kept (big buffer, fixed iteration
 count, throughput = bytes/elapsed —
@@ -36,50 +52,95 @@ K, M = 8, 4
 CHUNK = 1 << 20          # 1 MiB per shard
 BATCH = 8                # stripes per dispatch -> 64 MiB input per iter
 N1, N2 = 10, 110  # large span: the diff must dwarf tunnel RTT jitter
+REPS = 5
 TARGET_GBPS = 25.0
+V5E_HBM_GBPS = 819.0     # v5e-1 HBM bandwidth (public spec)
+LAT_CHUNK = 1 << 16      # 64 KiB single-chunk reconstruct latency probe
 
 
-def main() -> None:
+def _timed(fn, *args) -> float:
+    t0 = time.perf_counter()
+    np.asarray(fn(*args))  # readback forces real remote execution
+    return time.perf_counter() - t0
+
+
+def _per_iter(fn, *args) -> float:
+    """Median of positive differenced estimates (see module docstring)."""
+    diffs = []
+    for _ in range(REPS):
+        d = (_timed(fn, *args, N2) - _timed(fn, *args, N1)) / (N2 - N1)
+        if d > 0:
+            diffs.append(d)
+    if not diffs:
+        raise RuntimeError("all differenced timings were negative")
+    return float(np.median(diffs))
+
+
+def _loop_apply(encode, out_shards):
+    """On-device timing loop: perturb + apply + XOR-fold accumulator."""
     import jax
     import jax.numpy as jnp
 
-    from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
-    from ceph_tpu.ops.bitplane import gf_encode_bitplane
+    @jax.jit
+    def loop(data, iters):
+        def body(i, carry):
+            d, acc = carry
+            d = jnp.bitwise_xor(d, jnp.uint8(i + 1))
+            return d, jnp.bitwise_xor(acc, encode(d))
+
+        _, acc = jax.lax.fori_loop(
+            0, iters, body,
+            (data, jnp.zeros((BATCH, out_shards, CHUNK), jnp.uint8)),
+        )
+        return acc[0, 0, 0]
+
+    return loop
+
+
+def _measure_device_path(result: dict) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.gf import (
+        decode_matrix,
+        gf_matrix_to_bitmatrix,
+        vandermonde_rs_matrix,
+    )
     from ceph_tpu.ops import pallas_encode as pe
+    from ceph_tpu.ops.bitplane import gf_encode_bitplane
 
     g = vandermonde_rs_matrix(K, M)
-    bmat_np = gf_matrix_to_bitmatrix(g[K:, :])
-    bmat = jnp.asarray(bmat_np)
+    enc_bmat_np = gf_matrix_to_bitmatrix(g[K:, :])
+
+    # Decode config: lose data shards 4-7, survive on 0-3 + all parity
+    # (the exhaustive-erasures tool's worst standard case: a full-m
+    # erasure needing true matrix reconstruct, not passthrough).
+    present = [0, 1, 2, 3, 8, 9, 10, 11]
+    want = [4, 5, 6, 7]
+    dmat = decode_matrix(g, K, present)  # [k, len(present)]
+    dec_rows = np.stack([dmat[w, :] for w in want])
+    dec_bmat_np = gf_matrix_to_bitmatrix(dec_rows)
+
     rng = np.random.default_rng(0)
     data = jnp.asarray(
         rng.integers(0, 256, (BATCH, K, CHUNK)).astype(np.uint8)
     )
 
-    # The codec's TPU path: fused Pallas MXU kernel (einsum off-TPU).
-    use_pallas = pe.on_tpu() and pe.supported(data.shape)
-    if use_pallas:
-        big = jnp.asarray(pe._folded_bitmatrix(bmat_np, pe.FOLD))
+    on_tpu = pe.on_tpu()
 
-        def encode(bm, d):
-            return pe._encode_tiled(big, d, pe.FOLD, interpret=False)
-    else:
+    def make_apply(bmat_np):
+        if on_tpu:
+            big = jnp.asarray(pe._folded_bitmatrix(bmat_np, pe.FOLD))
 
-        def encode(bm, d):
-            return gf_encode_bitplane(bm, d)
+            def apply(d):
+                return pe._encode_tiled(big, d, pe.FOLD, interpret=False)
 
-    @jax.jit
-    def loop_enc(bmat, data, iters):
-        def body(i, carry):
-            d, acc = carry
-            d = jnp.bitwise_xor(d, jnp.uint8(i + 1))
-            p = encode(bmat, d)
-            return d, jnp.bitwise_xor(acc, p)
+            return apply
+        dev = jnp.asarray(bmat_np)
+        return lambda d: gf_encode_bitplane(dev, d)
 
-        _, acc = jax.lax.fori_loop(
-            0, iters, body,
-            (data, jnp.zeros((BATCH, M, CHUNK), jnp.uint8)),
-        )
-        return acc[0, 0, 0]
+    loop_enc = _loop_apply(make_apply(enc_bmat_np), M)
+    loop_dec = _loop_apply(make_apply(dec_bmat_np), M)
 
     @jax.jit
     def loop_perturb(data, iters):
@@ -94,37 +155,88 @@ def main() -> None:
         )
         return acc[0, 0, 0]
 
-    def timed(fn, *args) -> float:
+    # compile + warm every loop at both trip counts
+    for loop in (loop_enc, loop_dec, loop_perturb):
+        for n in (N1, N2):
+            _timed(loop, data, n)
+
+    pert_s = _per_iter(loop_perturb, data)
+    enc_s = max(_per_iter(loop_enc, data) - pert_s, 1e-9)
+    dec_s = max(_per_iter(loop_dec, data) - pert_s, 1e-9)
+
+    bytes_in = BATCH * K * CHUNK
+    enc_gbps = bytes_in / enc_s / 1e9
+    dec_gbps = bytes_in / dec_s / 1e9
+    hbm_gbps = (BATCH * (K + M) * CHUNK) / enc_s / 1e9
+
+    result["decode_gbps"] = round(dec_gbps, 2)
+    result["hbm_gbps"] = round(hbm_gbps, 1)
+    result["hbm_roofline_frac"] = round(hbm_gbps / V5E_HBM_GBPS, 3)
+    return enc_gbps
+
+
+def _measure_single_core(result: dict, enc_gbps: float) -> None:
+    """Native C single-core GF encode — the ISA-L-role CPU baseline
+    (BASELINE.md target: >= 10x). Same k/m, 1 MiB chunks."""
+    try:
+        from ceph_tpu import native
+        from ceph_tpu.gf import vandermonde_rs_matrix
+
+        if not native.available():
+            return
+        g = vandermonde_rs_matrix(K, M)
+        coding = np.ascontiguousarray(g[K:, :])
+        rng = np.random.default_rng(1)
+        data = rng.integers(0, 256, (K, CHUNK), np.uint8)
+        native.gf_matrix_encode(coding, data)  # warm
+        iters, t0 = 8, time.perf_counter()
+        for _ in range(iters):
+            native.gf_matrix_encode(coding, data)
+        dt = (time.perf_counter() - t0) / iters
+        cpu_gbps = K * CHUNK / dt / 1e9
+        result["single_core_gbps"] = round(cpu_gbps, 3)
+        result["vs_single_core"] = round(enc_gbps / cpu_gbps, 1)
+    except Exception:
+        pass  # baseline is best-effort; the headline must still print
+
+
+def _measure_reconstruct_latency(result: dict) -> None:
+    """p50/p99 single-chunk reconstruct on the host small-op path —
+    the low-latency lane beside the bulk device path (SURVEY.md §7
+    "small-chunk latency vs batch throughput"). True per-op wall
+    time: numpy in, numpy out, no device round trip."""
+    from ceph_tpu.codecs.registry import registry
+
+    codec = registry.factory("isa", {"k": str(K), "m": str(M)})
+    rng = np.random.default_rng(2)
+    data = {i: rng.integers(0, 256, (LAT_CHUNK,), np.uint8) for i in range(K)}
+    parity = codec.encode_chunks(data)
+    chunks = {**data, **parity}
+    del chunks[5]  # one lost data shard, the common repair case
+    lat = []
+    for _ in range(200):
         t0 = time.perf_counter()
-        np.asarray(fn(*args))  # readback forces real remote execution
-        return time.perf_counter() - t0
+        out = codec.decode_chunks({5}, chunks)
+        np.asarray(out[5])
+        lat.append(time.perf_counter() - t0)
+    lat_ms = np.array(lat) * 1e3
+    result["reconstruct_p50_ms"] = round(float(np.percentile(lat_ms, 50)), 3)
+    result["reconstruct_p99_ms"] = round(float(np.percentile(lat_ms, 99)), 3)
 
-    # compile + warm both trip counts
-    for n in (N1, N2):
-        timed(loop_enc, bmat, data, n)
-        timed(loop_perturb, data, n)
 
-    # Repeat and keep the minimum: tunnel latency jitter is additive,
-    # so the noise floor is the honest estimate.
-    def per_iter(fn, *args) -> float:
-        best = float("inf")
-        for _ in range(3):
-            d = (timed(fn, *args, N2) - timed(fn, *args, N1)) / (N2 - N1)
-            best = min(best, d)
-        return best
-
-    per_iter_full = per_iter(loop_enc, bmat, data)
-    per_iter_perturb = per_iter(loop_perturb, data)
-    enc_s = max(per_iter_full - per_iter_perturb, 1e-9)
-
-    gbps = BATCH * K * CHUNK / enc_s / 1e9
+def main() -> None:
+    result: dict = {}
+    enc_gbps = _measure_device_path(result)
+    _measure_single_core(result, enc_gbps)
+    _measure_reconstruct_latency(result)
     print(
         json.dumps(
             {
                 "metric": f"EC({K},{M}) reed_sol_van batched stripe encode",
-                "value": round(gbps, 2),
+                "value": round(enc_gbps, 2),
                 "unit": "GB/s data-in per chip",
-                "vs_baseline": round(gbps / TARGET_GBPS, 3),
+                "vs_baseline": round(enc_gbps / TARGET_GBPS, 3),
+                **result,
             }
         )
     )

@@ -9,6 +9,13 @@ flot-plot role, dependency-free).
     python -m ceph_tpu.bench_sweep --plugins isa jerasure \
         --k 2 4 8 --m 2 4 --size 16777216 --iterations 5 \
         --html bench.html
+
+``--baseline`` ignores the sweep axes and reproduces the five
+BASELINE.md benchmark configs 1:1 (jerasure rs k=4 m=2 4K; isa rs
+k=8 m=3 64K; cauchy k=10 m=4 1M x 1024 stripes; CLAY (8,4,d=11)
+single-chunk repair; CRC32C over 4/16/64 KiB blocks):
+
+    python -m ceph_tpu.bench_sweep --baseline
 """
 
 from __future__ import annotations
@@ -31,7 +38,62 @@ def parse_args(argv=None):
     p.add_argument("--erasures", type=int, default=1)
     p.add_argument("--html", default=None,
                    help="also write a self-contained HTML chart here")
+    p.add_argument("--baseline", action="store_true",
+                   help="run the five BASELINE.md configs instead of "
+                        "the k/m sweep")
     return p.parse_args(argv)
+
+
+# BASELINE.md "Benchmark configs to reproduce 1:1". Sizes follow the
+# config text (per-chunk/stripe bytes); iterations kept modest so the
+# full set runs in minutes on one chip.
+BASELINE_CONFIGS: list[tuple[str, list[str]]] = [
+    # --size is total bytes per iteration across the stripe batch:
+    # chunk_bytes * k * batch.
+    ("1 jerasure reed_sol_van k=4 m=2 4K chunks",
+     ["encode", "--plugin", "jerasure", "-P", "technique=reed_sol_van",
+      "-P", "k=4", "-P", "m=2", "--size", str(4096 * 4 * 256),
+      "--batch", "256", "--iterations", "20"]),
+    ("2 isa rs k=8 m=3 64K stripe",
+     ["encode", "--plugin", "isa", "-P", "k=8", "-P", "m=3",
+      "--size", str(64 * 1024 * 64), "--batch", "64",
+      "--iterations", "20"]),
+    ("3 cauchy k=10 m=4 1M objects, 1024-stripe batch",
+     ["encode", "--plugin", "jerasure", "-P", "technique=cauchy_good",
+      "-P", "k=10", "-P", "m=4", "--size", str((1 << 20) * 1024),
+      "--batch", "1024", "--iterations", "10"]),
+    ("4 clay (8,4,d=11) single-chunk repair",
+     ["repair", "--plugin", "clay", "-P", "k=8", "-P", "m=4",
+      "-P", "d=11", "--size", str(1 << 20), "--iterations", "12"]),
+    ("5a crc32c 4K blocks", ["checksum", "--csum-alg", "crc32c",
+     "--csum-block", "4096", "--size", str(64 << 20), "--iterations", "5"]),
+    ("5b crc32c 16K blocks", ["checksum", "--csum-alg", "crc32c",
+     "--csum-block", "16384", "--size", str(64 << 20), "--iterations", "5"]),
+    ("5c crc32c 64K blocks", ["checksum", "--csum-alg", "crc32c",
+     "--csum-block", "65536", "--size", str(64 << 20), "--iterations", "5"]),
+]
+
+
+def run_baseline() -> list[dict]:
+    from ceph_tpu import bench_cli
+
+    results = []
+    for name, argv in BASELINE_CONFIGS:
+        try:
+            elapsed, total_kib = bench_cli.run(bench_cli.parse_args(argv))
+        except (ValueError, RuntimeError) as e:
+            row = {"config": name, "error": str(e)}
+        else:
+            gbps = total_kib * 1024 / max(elapsed, 1e-9) / 1e9
+            row = {
+                "config": name,
+                "seconds": round(elapsed, 6),
+                "KiB": int(total_kib),
+                "GBps": round(gbps, 3),
+            }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+    return results
 
 
 def sweep(args) -> list[dict]:
@@ -94,7 +156,8 @@ const el = document.getElementById("chart");
 for (const d of data) {{
   const row = document.createElement("div");
   row.className = "row";
-  const label = `${{d.plugin}} k=${{d.k}} m=${{d.m}} ${{d.workload}}`;
+  const label = d.config ??
+    `${{d.plugin}} k=${{d.k}} m=${{d.m}} ${{d.workload}}`;
   if (d.error) {{
     row.innerHTML = `<div class="lbl">${{label}}</div>` +
       `<div></div><div class="val">error</div>`;
@@ -117,7 +180,7 @@ def write_html(path: str, results: list[dict]) -> None:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    results = sweep(args)
+    results = run_baseline() if args.baseline else sweep(args)
     if args.html:
         write_html(args.html, results)
         print(f"wrote {args.html}", file=sys.stderr)

@@ -95,11 +95,20 @@ def csum_value_size(alg: str) -> int:
 
 
 def _as_blocks(
-    data: bytes | np.ndarray, csum_block_size: int
+    data, csum_block_size: int
 ) -> np.ndarray:
-    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
-        data, (bytes, bytearray, memoryview)
-    ) else np.asarray(data, dtype=np.uint8).reshape(-1)
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        buf = np.frombuffer(data, dtype=np.uint8)
+    elif isinstance(data, np.ndarray):
+        buf = np.asarray(data, dtype=np.uint8).reshape(-1)
+    else:
+        # Device (jax) array: keep it resident — blocks feed the device
+        # kernels without a host round trip (a BlueStore blob already
+        # in HBM verifies in place; only the tiny csum array returns).
+        # Coerce to uint8 like the host branches, so size counts BYTES.
+        if str(data.dtype) != "uint8":
+            data = data.astype("uint8")
+        buf = data.reshape(-1)
     if buf.size % csum_block_size:
         raise ValueError(
             f"length {buf.size} not a multiple of block {csum_block_size}"

@@ -277,6 +277,11 @@ class Monitor:
                 return None
             for osd in expired:
                 del self._down_since[osd]
+            from ceph_tpu.utils.log import get_logger
+
+            get_logger("mon").info(
+                "auto-out after down-out interval: osds", expired
+            )
             return self._propose(out=tuple(expired))
 
     # -- EC profiles & pools (OSDMonitor::parse_erasure_code_profile) ----

@@ -284,7 +284,10 @@ class OSDDaemon:
         tick_period: float = 2.0,
         scheduler_profiles=None,
     ) -> None:
+        from ceph_tpu.utils.log import get_logger
+
         self.osd_id = osd_id
+        self.log = get_logger(f"osd.{osd_id}")
         self.monitor = monitor
         self.store = store if store is not None else MemStore(f"osd.{osd_id}")
         self.chunk_size = chunk_size
@@ -353,8 +356,17 @@ class OSDDaemon:
             _cls, fn = got
             try:
                 fn()
-            except Exception:
-                pass  # op errors reply themselves; never kill the worker
+            except Exception as e:
+                # Op errors reply themselves deeper down; anything
+                # surfacing here is an unexpected pipeline fault —
+                # keep the worker alive but dump the gather ring so
+                # the verbose context survives (Log::dump_recent).
+                self.log.error(
+                    "unexpected worker exception:", type(e).__name__, e
+                )
+                from ceph_tpu.utils.log import root_log
+
+                root_log.dump_recent("osd worker exception")
 
     def _schedule(self, class_name: str, fn, cost: float = 1.0) -> None:
         with self._sched_cv:
@@ -626,7 +638,16 @@ class OSDDaemon:
                     )
                 pg.backend.recovering.discard(shard)
                 pg.rmw.on_shard_recovered(shard)
-        except Exception:
+            self.log.info(
+                "pg", f"{pg.pool}/{pg.pgid}:", "shard", shard,
+                "caught up, admitted"
+            )
+        except Exception as e:
+            self.log.error(
+                "pg", f"{pg.pool}/{pg.pgid}:", "shard", shard,
+                "catch-up failed", f"({type(e).__name__}: {e});",
+                "reverting to hole"
+            )
             with self._pg_lock:
                 pg.acting[shard] = SHARD_NONE
                 pg.backend.acting[shard] = SHARD_NONE
@@ -793,6 +814,10 @@ class OSDDaemon:
         try:
             reply = self._execute_client_op(msg)
         except Exception as e:  # never kill the worker
+            self.log.error(
+                "client op", msg.op, f"{msg.pool}/{msg.oid}",
+                "tid", msg.tid, "failed:", type(e).__name__, e
+            )
             reply = OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio", data=str(e).encode()
             )
@@ -1115,6 +1140,10 @@ class OSDDaemon:
             spec = self.osdmap.pools[pool]
             # pass 1: scan + move everything currently known
             hints = self._backfill_scan(pool, pgid, spec, pg)
+            self.log.debug(
+                "backfill pg", f"{pool}/{pgid}:", len(hints),
+                "objects to place"
+            )
             for oid in sorted(hints):
                 # QoS: each object move admits through the backfill
                 # class so client IO keeps its reservation

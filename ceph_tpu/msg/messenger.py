@@ -17,40 +17,95 @@ import socket
 import threading
 from collections.abc import Callable
 
+from . import secure as secure_mod
 from .messages import decode_message, message_type
 from .wire import decode_frame, encode_frame
 
+# In-the-clear handshake frame type for secure-mode nonce exchange
+# (outside the normal message-type space; auth_none + CephX roles).
+HANDSHAKE_TYPE = 0x7FFF
+
 
 class Connection:
-    """One peer link; ``send(msg)`` frames and writes atomically."""
+    """One peer link; ``send(msg)`` frames and writes atomically.
 
-    def __init__(self, sock: socket.socket, messenger: "Messenger") -> None:
+    With a cluster secret configured, the connection runs the secure
+    handshake (nonce exchange -> per-direction AES-GCM sessions)
+    synchronously before the reader thread starts, so no payload
+    message ever travels in the clear."""
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        messenger: "Messenger",
+        is_client: bool = False,
+    ) -> None:
         self.sock = sock
         self.messenger = messenger
         self._send_lock = threading.Lock()
         self._seq = 0
         self.alive = True
+        self._tx = self._rx = None
+        if messenger.secret is not None:
+            try:
+                self._handshake(is_client)
+            except Exception:
+                self.alive = False
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
-    def send(self, msg) -> None:
-        frame = encode_frame(
-            message_type(msg),
-            self._next_seq(),
-            msg.encode(),
-            compress=self.messenger.compress,
+    def _handshake(self, is_client: bool) -> None:
+        # Bounded: a peer that connects and goes silent must not wedge
+        # the accept loop (the reference bounds auth exchanges too).
+        self.sock.settimeout(5)
+        try:
+            self._do_handshake(is_client)
+        finally:
+            self.sock.settimeout(None)
+
+    def _do_handshake(self, is_client: bool) -> None:
+        my_nonce = secure_mod.fresh_nonce()
+        hello = encode_frame(HANDSHAKE_TYPE, 0, [my_nonce])
+        if is_client:
+            self.sock.sendall(hello)
+            peer_nonce = self._read_handshake()
+            nonce_c, nonce_s = my_nonce, peer_nonce
+        else:
+            peer_nonce = self._read_handshake()
+            self.sock.sendall(hello)
+            nonce_c, nonce_s = peer_nonce, my_nonce
+        self._tx, self._rx = secure_mod.derive_session(
+            self.messenger.secret, nonce_c, nonce_s, is_client
         )
+
+    def _read_handshake(self) -> bytes:
+        msg_type, _seq, segments = decode_frame(self._read_exact)
+        if msg_type != HANDSHAKE_TYPE or len(segments) != 1:
+            raise ConnectionError("peer did not offer secure handshake")
+        return segments[0]
+
+    def send(self, msg) -> None:
         with self._send_lock:
+            self._seq += 1
+            # Sealing must happen under the send lock: the AEAD tx
+            # counter and the socket write have to agree on order.
+            frame = encode_frame(
+                message_type(msg),
+                self._seq,
+                msg.encode(),
+                compress=self.messenger.compress,
+                secure=self._tx,
+            )
             try:
                 self.sock.sendall(frame)
             except OSError as e:
                 self.alive = False
                 raise ConnectionError(str(e)) from e
-
-    def _next_seq(self) -> int:
-        with self._send_lock:
-            self._seq += 1
-            return self._seq
 
     def _read_exact(self, n: int) -> bytes:
         buf = b""
@@ -64,7 +119,9 @@ class Connection:
     def _read_loop(self) -> None:
         try:
             while True:
-                msg_type, _seq, segments = decode_frame(self._read_exact)
+                msg_type, _seq, segments = decode_frame(
+                    self._read_exact, secure=self._rx
+                )
                 msg = decode_message(msg_type, segments)
                 self.messenger.dispatch(self, msg)
         except (EOFError, OSError):
@@ -95,11 +152,21 @@ class Connection:
 class Messenger:
     """Bind/connect endpoint + dispatcher registry."""
 
-    def __init__(self, name: str, compress: bool = False) -> None:
+    def __init__(
+        self,
+        name: str,
+        compress: bool = False,
+        secret: bytes | None = None,
+    ) -> None:
         self.name = name
         # On-wire compression for frames WE send (receivers auto-detect
         # via the frame flags — compression_onwire.cc role).
         self.compress = compress
+        # Cluster pre-shared secret (keyring role): non-None enables
+        # AES-GCM secure mode on every connection of this messenger.
+        # Both ends must agree — a secure peer rejects clear frames
+        # and vice versa (mode is per-connection, negotiated up front).
+        self.secret = secret
         self.dispatcher: Callable[[Connection, object], None] | None = None
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
@@ -145,12 +212,27 @@ class Messenger:
                 break
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                self._conns.add(Connection(sock, self))
+            # Finish connection setup off the accept thread: the secure
+            # handshake blocks up to its 5 s timeout, and one silent
+            # connector must not starve other peers' accepts.
+            threading.Thread(
+                target=self._finish_accept, args=(sock,), daemon=True
+            ).start()
         try:
             self._listener.close()
         except OSError:
             pass
+
+    def _finish_accept(self, sock: socket.socket) -> None:
+        try:
+            conn = Connection(sock, self, is_client=False)
+        except Exception:
+            return  # failed handshake drops the socket, not us
+        with self._lock:
+            if self._stopping:
+                conn.close()
+                return
+            self._conns.add(conn)
 
     # -- client side ---------------------------------------------------
     def connect(self, addr: tuple[str, int]) -> Connection:
@@ -162,7 +244,7 @@ class Messenger:
             raise ConnectionError(f"self-connect to dead peer {addr}")
         sock.settimeout(None)  # connect timeout must not become a
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)  # recv timeout
-        conn = Connection(sock, self)
+        conn = Connection(sock, self, is_client=True)
         with self._lock:
             self._conns.add(conn)
         return conn

@@ -48,7 +48,12 @@ from .messenger import Connection, Messenger
 class ShardServer:
     """One shard's daemon: store + messenger + sub-op handlers."""
 
-    def __init__(self, shard: int, store: MemStore | None = None) -> None:
+    def __init__(
+        self,
+        shard: int,
+        store: MemStore | None = None,
+        secret: bytes | None = None,
+    ) -> None:
         from ceph_tpu.pipeline.rmw import ShardBackend
 
         self.shard = shard
@@ -56,7 +61,7 @@ class ShardServer:
         # Delegate sub-op semantics (zero-pad reads, inject hooks) to
         # the same backend the in-process pipelines use.
         self._local = ShardBackend({shard: self.store})
-        self.messenger = Messenger(f"osd.{shard}")
+        self.messenger = Messenger(f"osd.{shard}", secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
         self.addr: tuple[str, int] | None = None
 
@@ -125,12 +130,18 @@ class NetShardBackend:
     """
 
     def __init__(
-        self, addrs: dict[int, tuple[str, int]], timeout: float = 10.0
+        self,
+        addrs: dict[int, tuple[str, int]],
+        timeout: float = 10.0,
+        secret: bytes | None = None,
     ) -> None:
+        from ceph_tpu.utils.log import get_logger
+
         self.addrs = dict(addrs)
         self.timeout = timeout
         self.down_shards: set[int] = set()
-        self.messenger = Messenger("client")
+        self._log = get_logger("msgr")
+        self.messenger = Messenger("client", secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
         self._conns: dict[int, Connection] = {}
         self._tids = itertools.count(1)
@@ -188,6 +199,8 @@ class NetShardBackend:
         except (ConnectionError, OSError, KeyError):
             with self._lock:
                 self._waiting.pop((tid, shard), None)
+            if shard not in self.down_shards:
+                self._log.info("shard", shard, "marked down (send failed)")
             self.down_shards.add(shard)
             return False
 
@@ -202,6 +215,8 @@ class NetShardBackend:
                     expired.append((key, entry))
                     del self._waiting[key]
         for (tid, shard), entry in expired:
+            if shard not in self.down_shards:
+                self._log.info("shard", shard, "marked down (rpc timeout)")
             self.down_shards.add(shard)
             if entry.is_read:
                 from ceph_tpu.pipeline.read import ShardReadError

@@ -10,7 +10,19 @@ segment's crc32c is verified on decode — a flipped bit anywhere raises
 On-wire compression is flag bit 0 (the compression_onwire.cc analog):
 segments are zlib-deflated before framing and the per-segment CRC
 covers the compressed bytes, so corruption is still caught before any
-decompressor touches the data. AES-GCM secure mode remains reserved.
+decompressor touches the data.
+
+AES-GCM secure mode is flag bit 1 (the crypto_onwire.cc analog — see
+secure.py): the segment table and payloads are sealed into one AEAD
+blob with the frame header as associated data, and the GCM tag
+REPLACES per-segment CRC (ProtocolV2 rev-1 secure mode likewise
+drops crc protection in favor of the auth tag). Layout:
+
+    header | counter u64 | ct_len u32 | ciphertext+tag
+
+Compression composes: segments deflate first, then the whole frame
+body seals. Tampering with header or body raises ``BadFrame`` via the
+AEAD check; replayed frames are rejected by the session counter.
 """
 
 from __future__ import annotations
@@ -23,9 +35,12 @@ from ceph_tpu.checksum.host import crc32c as _crc32c_host
 MAGIC = b"CTv2"
 _HDR = struct.Struct("<4sHBBQ")  # magic, type, flags, nseg, seq
 _SEG = struct.Struct("<II")      # length, crc32c
+_SLEN = struct.Struct("<I")      # secure mode: plain length table entry
+_SECHDR = struct.Struct("<QI")   # secure mode: counter, ciphertext len
 CRC_SEED = 0xFFFFFFFF
 
 FLAG_COMPRESSED = 0x01
+FLAG_SECURE = 0x02
 
 MAX_SEGMENTS = 8
 MAX_SEGMENT_BYTES = 1 << 30
@@ -40,14 +55,30 @@ def _crc(data: bytes) -> int:
 
 
 def encode_frame(
-    msg_type: int, seq: int, segments: list[bytes], compress: bool = False
+    msg_type: int,
+    seq: int,
+    segments: list[bytes],
+    compress: bool = False,
+    secure=None,
 ) -> bytes:
+    """Frame ``segments``; ``secure`` is a secure.SecureSession for
+    AES-GCM sealing (tx direction) or None for crc mode."""
     if not 0 < len(segments) <= MAX_SEGMENTS:
         raise ValueError(f"1..{MAX_SEGMENTS} segments, got {len(segments)}")
     flags = 0
     if compress:
         flags |= FLAG_COMPRESSED
         segments = [zlib.compress(seg, 1) for seg in segments]
+    if secure is not None:
+        flags |= FLAG_SECURE
+        hdr = _HDR.pack(MAGIC, msg_type, flags, len(segments), seq)
+        body = bytearray()
+        for seg in segments:
+            body += _SLEN.pack(len(seg))
+        for seg in segments:
+            body += seg
+        counter, ct = secure.seal(hdr, bytes(body))
+        return hdr + _SECHDR.pack(counter, len(ct)) + ct
     out = bytearray(_HDR.pack(MAGIC, msg_type, flags, len(segments), seq))
     for seg in segments:
         out += _SEG.pack(len(seg), _crc(seg))
@@ -56,18 +87,55 @@ def encode_frame(
     return bytes(out)
 
 
-def decode_frame(read_exact) -> tuple[int, int, list[bytes]]:
+def decode_frame(read_exact, secure=None) -> tuple[int, int, list[bytes]]:
     """Parse one frame from ``read_exact(n) -> bytes`` (raises
     ``EOFError`` at stream end). Returns (msg_type, seq, segments).
-    Compressed frames are transparently inflated AFTER CRC checks."""
+    Compressed frames are transparently inflated AFTER CRC (or AEAD)
+    checks. ``secure`` is the rx-direction secure.SecureSession; a
+    secure frame arriving without one (or vice versa) is rejected —
+    mode is negotiated per connection, not per frame."""
     hdr = read_exact(_HDR.size)
     magic, msg_type, flags, nseg, seq = _HDR.unpack(hdr)
     if magic != MAGIC:
         raise BadFrame(f"bad magic {magic!r}")
-    if flags & ~FLAG_COMPRESSED:
+    if flags & ~(FLAG_COMPRESSED | FLAG_SECURE):
         raise BadFrame(f"unsupported flags {flags:#x}")
     if not 0 < nseg <= MAX_SEGMENTS:
         raise BadFrame(f"bad segment count {nseg}")
+    if bool(flags & FLAG_SECURE) != (secure is not None):
+        raise BadFrame(
+            "secure-mode mismatch: frame "
+            + ("sealed" if flags & FLAG_SECURE else "clear")
+            + " but session "
+            + ("clear" if secure is None else "secure")
+        )
+    if secure is not None:
+        from .secure import SecurityError
+
+        counter, ct_len = _SECHDR.unpack(read_exact(_SECHDR.size))
+        if ct_len > MAX_SEGMENT_BYTES:
+            raise BadFrame(f"ciphertext too large: {ct_len}")
+        try:
+            body = secure.open(hdr, counter, read_exact(ct_len))
+        except SecurityError as e:
+            raise BadFrame(str(e)) from e
+        pos = nseg * _SLEN.size
+        lengths = [
+            _SLEN.unpack_from(body, i * _SLEN.size)[0] for i in range(nseg)
+        ]
+        if pos + sum(lengths) != len(body):
+            raise BadFrame("secure body length mismatch")
+        segments = []
+        for length in lengths:
+            seg = body[pos : pos + length]
+            pos += length
+            if flags & FLAG_COMPRESSED:
+                try:
+                    seg = zlib.decompress(seg)
+                except zlib.error as e:
+                    raise BadFrame(f"segment inflate failed: {e}") from e
+            segments.append(seg)
+        return msg_type, seq, segments
     table = []
     for _ in range(nseg):
         length, crc = _SEG.unpack(read_exact(_SEG.size))
@@ -90,7 +158,7 @@ def decode_frame(read_exact) -> tuple[int, int, list[bytes]]:
     return msg_type, seq, segments
 
 
-def frame_from_buffer(buf: bytes) -> tuple[int, int, list[bytes]]:
+def frame_from_buffer(buf: bytes, secure=None) -> tuple[int, int, list[bytes]]:
     """Decode a frame held fully in memory (tests / datagram use)."""
     pos = 0
 
@@ -102,4 +170,4 @@ def frame_from_buffer(buf: bytes) -> tuple[int, int, list[bytes]]:
         pos += n
         return out
 
-    return decode_frame(read_exact)
+    return decode_frame(read_exact, secure=secure)

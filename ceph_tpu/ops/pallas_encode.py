@@ -13,11 +13,15 @@ Two Mosaic/TPU realities shape the code:
   bit twiddling happens in int32 and the bit planes are laid out
   PLANE-MAJOR as 2-D concatenations; the coding matrix is row/column
   permuted host-side to match (``_plane_major_bitmatrix``).
-- A [M*8, K*8] matmul (e.g. [32, 64] for EC(8,4)) wastes most of the
-  128x128 MXU. ``FOLD`` chunk quarters are encoded in one
-  block-diagonal matmul ([FOLD*8M, FOLD*8K]) so the systolic array
-  tiles fully — measured +16% over the einsum path for EC(8,4) on
-  v5e (62 -> 73 GB/s data-in per chip).
+- Tile size on the chunk (lane) axis is the dominant knob: the r1
+  kernel used 2 KB tiles and a FOLD=4 block-diagonal matmul (73 GB/s
+  claimed, 54 measured end-to-end). Sweeping on v5e showed large lane
+  tiles beat folding outright — fold=1 @ 16-64 KB tiles sustains
+  85-89 GB/s data-in vs 57 GB/s for fold=4 @ 2 KB; fold>1 never wins
+  once tiles exceed 8 KB. Default is now fold=1 with the largest
+  power-of-two tile <= 64 KB that divides the chunk ("MXU waste" was
+  the wrong mental model: the [32, 64] matmul streams fine along the
+  lane axis; grid-step overhead was the real cost).
 
 Falls back to the einsum path off-TPU; unit tests run the kernel in
 interpreter mode so CPU CI covers it bit-exactly.
@@ -32,8 +36,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-LANE_TILE = 2048  # bytes of the chunk axis per kernel instance
-FOLD = 4          # chunk quarters per MXU call (block-diagonal matrix)
+LANE_TILE = 2048       # minimum chunk-axis granularity the kernel accepts
+MAX_LANE_TILE = 65536  # largest tile worth using (sweep-flat above 16K)
+FOLD = 1               # chunk fractions per MXU call (1 = no folding)
+
+
+def _pick_lane_tile(n: int) -> int:
+    """Largest power-of-two tile <= MAX_LANE_TILE dividing the chunk."""
+    t = MAX_LANE_TILE
+    while t > LANE_TILE and n % t:
+        t //= 2
+    return t
 
 
 def _plane_major_bitmatrix(bitmatrix: np.ndarray, k: int, m: int) -> np.ndarray:
@@ -91,18 +104,22 @@ def _make_kernel(fold: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("fold", "interpret"))
-def _encode_tiled(bmat_big, data, fold, interpret=False):
+@functools.partial(
+    jax.jit, static_argnames=("fold", "lane_tile", "interpret")
+)
+def _encode_tiled(bmat_big, data, fold, lane_tile=None, interpret=False):
     batch, k, n = data.shape
     m = bmat_big.shape[0] // 8 // fold
+    if lane_tile is None:
+        lane_tile = _pick_lane_tile(n)
     return pl.pallas_call(
         _make_kernel(fold),
-        grid=(batch, n // LANE_TILE),
+        grid=(batch, n // lane_tile),
         in_specs=[
             pl.BlockSpec(bmat_big.shape, lambda b, c: (0, 0)),
-            pl.BlockSpec((1, k, LANE_TILE), lambda b, c: (b, 0, c)),
+            pl.BlockSpec((1, k, lane_tile), lambda b, c: (b, 0, c)),
         ],
-        out_specs=pl.BlockSpec((1, m, LANE_TILE), lambda b, c: (b, 0, c)),
+        out_specs=pl.BlockSpec((1, m, lane_tile), lambda b, c: (b, 0, c)),
         out_shape=jax.ShapeDtypeStruct((batch, m, n), jnp.uint8),
         interpret=interpret,
     )(bmat_big, data)

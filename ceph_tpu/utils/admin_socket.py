@@ -105,6 +105,33 @@ def _register_builtins(sock: AdminSocket) -> None:
         "recently completed trace spans",
     )
 
+    from ceph_tpu.utils.log import root_log
+
+    sock.register(
+        "log dump",
+        lambda reason="admin": root_log.dump_recent(reason),
+        "dump the ring of recent (gathered) log entries",
+    )
+    sock.register(
+        "log flush", lambda: root_log.flush(),
+        "flush queued log entries to the sink",
+    )
+    sock.register(
+        "log set",
+        lambda subsys, level, gather=None: (
+            root_log.set_level(
+                subsys, int(level),
+                None if gather is None else int(gather),
+            ),
+            root_log.dump_levels().get(subsys),
+        )[1],
+        "set a subsystem's log/gather levels (debug_<subsys> analog)",
+    )
+    sock.register(
+        "log levels", lambda: root_log.dump_levels(),
+        "per-subsystem log/gather level pairs",
+    )
+
     def _inject(kind: str):
         def run(oid, type, when=0, duration=1, shard=None):
             from ceph_tpu.pipeline.inject import ANY_SHARD, ec_inject

@@ -1,0 +1,162 @@
+"""AES-GCM secure mode (crypto_onwire analog): sealed round trips,
+tamper and replay rejection, clear/secure mode mismatch, key
+separation per direction, and the networked shard tier end-to-end
+over sealed frames (both modes must keep passing — VERDICT r1 item 7).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.msg import secure
+from ceph_tpu.msg.wire import (
+    BadFrame,
+    FLAG_SECURE,
+    encode_frame,
+    frame_from_buffer,
+)
+
+PSK = b"cluster-keyring-secret"
+
+
+def sessions():
+    nc, ns = secure.fresh_nonce(), secure.fresh_nonce()
+    ctx, crx = secure.derive_session(PSK, nc, ns, is_client=True)
+    stx, srx = secure.derive_session(PSK, nc, ns, is_client=False)
+    return ctx, crx, stx, srx
+
+
+class TestSession:
+    def test_directions_are_independent_keys(self):
+        ctx, crx, stx, srx = sessions()
+        # client tx opens only with server rx, not with client rx
+        ctr, ct = ctx.seal(b"aad", b"payload")
+        assert srx.open(b"aad", ctr, ct) == b"payload"
+        ctr2, ct2 = ctx.seal(b"aad", b"payload")
+        with pytest.raises(secure.SecurityError):
+            crx.open(b"aad", ctr2, ct2)
+
+    def test_tampered_ciphertext_rejected(self):
+        ctx, _, _, srx = sessions()
+        ctr, ct = ctx.seal(b"aad", b"secret bytes")
+        bad = bytes([ct[0] ^ 1]) + ct[1:]
+        with pytest.raises(secure.SecurityError):
+            srx.open(b"aad", ctr, bad)
+
+    def test_tampered_aad_rejected(self):
+        ctx, _, _, srx = sessions()
+        ctr, ct = ctx.seal(b"header", b"secret bytes")
+        with pytest.raises(secure.SecurityError):
+            srx.open(b"forged", ctr, ct)
+
+    def test_replay_rejected(self):
+        ctx, _, _, srx = sessions()
+        ctr, ct = ctx.seal(b"aad", b"one")
+        assert srx.open(b"aad", ctr, ct) == b"one"
+        with pytest.raises(secure.SecurityError, match="replay"):
+            srx.open(b"aad", ctr, ct)
+
+    def test_wrong_psk_fails_open(self):
+        nc, ns = secure.fresh_nonce(), secure.fresh_nonce()
+        ctx, _ = secure.derive_session(PSK, nc, ns, is_client=True)
+        _, srx = secure.derive_session(b"other", nc, ns, is_client=False)
+        ctr, ct = ctx.seal(b"aad", b"data")
+        with pytest.raises(secure.SecurityError):
+            srx.open(b"aad", ctr, ct)
+
+
+class TestSecureFrames:
+    def test_round_trip(self):
+        ctx, _, _, srx = sessions()
+        segs = [b"header-ish", b"y" * 10000, b""]
+        buf = encode_frame(9, 3, segs, secure=ctx)
+        assert frame_from_buffer(buf, secure=srx) == (9, 3, segs)
+
+    def test_payload_not_in_clear(self):
+        ctx, *_ = sessions()
+        buf = encode_frame(9, 1, [b"SUPERSECRETPAYLOAD"], secure=ctx)
+        assert b"SUPERSECRETPAYLOAD" not in buf
+
+    def test_flag_set(self):
+        ctx, *_ = sessions()
+        buf = encode_frame(9, 1, [b"x"], secure=ctx)
+        assert buf[6] & FLAG_SECURE
+
+    def test_tamper_any_byte_rejected(self):
+        ctx, _, _, srx = sessions()
+        buf = bytearray(encode_frame(9, 1, [b"q" * 500], secure=ctx))
+        buf[-7] ^= 0x10
+        with pytest.raises(BadFrame):
+            frame_from_buffer(bytes(buf), secure=srx)
+
+    def test_header_tamper_rejected(self):
+        """The header rides as AAD: flipping the message type breaks
+        the tag even though the header itself is plaintext."""
+        ctx, _, _, srx = sessions()
+        buf = bytearray(encode_frame(9, 1, [b"q" * 64], secure=ctx))
+        buf[4] ^= 0x01  # msg_type low byte
+        with pytest.raises(BadFrame):
+            frame_from_buffer(bytes(buf), secure=srx)
+
+    def test_mode_mismatch_rejected_both_ways(self):
+        ctx, _, _, srx = sessions()
+        clear = encode_frame(9, 1, [b"x"])
+        with pytest.raises(BadFrame, match="secure-mode mismatch"):
+            frame_from_buffer(clear, secure=srx)
+        sealed = encode_frame(9, 1, [b"x"], secure=ctx)
+        with pytest.raises(BadFrame, match="secure-mode mismatch"):
+            frame_from_buffer(sealed)
+
+    def test_compression_composes(self):
+        ctx, _, _, srx = sessions()
+        segs = [b"A" * 50_000]
+        buf = encode_frame(9, 1, segs, compress=True, secure=ctx)
+        assert len(buf) < 2000  # deflated before sealing
+        assert frame_from_buffer(buf, secure=srx)[2] == segs
+
+
+class TestSecureShardTier:
+    def test_write_read_over_sealed_frames(self, rng):
+        from ceph_tpu.msg import NetShardBackend, ShardServer
+        from ceph_tpu.pipeline.extents import ExtentSet
+        from ceph_tpu.store import Transaction
+
+        server = ShardServer(0, secret=PSK)
+        addr = server.start()
+        backend = NetShardBackend({0: addr}, timeout=3.0, secret=PSK)
+        try:
+            payload = rng.integers(0, 256, 4096, np.uint8).tobytes()
+            acked = []
+            backend.submit_shard_txn(
+                0,
+                Transaction().write("o", 0, payload),
+                lambda: acked.append(True),
+            )
+            backend.drain_until(lambda: acked)
+            out = backend.read_shard(0, "o", ExtentSet([(0, len(payload))]))
+            assert out[0] == payload
+        finally:
+            backend.shutdown()
+            server.stop()
+
+    def test_wrong_secret_cannot_talk(self):
+        from ceph_tpu.msg import NetShardBackend, ShardServer
+        from ceph_tpu.store import Transaction
+
+        server = ShardServer(0, secret=PSK)
+        addr = server.start()
+        backend = NetShardBackend(
+            {0: addr}, timeout=0.5, secret=b"wrong-key"
+        )
+        try:
+            acked = []
+            with pytest.raises((TimeoutError, ConnectionError)):
+                backend.submit_shard_txn(
+                    0,
+                    Transaction().write("o", 0, b"data"),
+                    lambda: acked.append(True),
+                )
+                backend.drain_until(lambda: acked, timeout=1.0)
+            assert not acked
+        finally:
+            backend.shutdown()
+            server.stop()
