@@ -60,6 +60,8 @@ from ceph_tpu.pipeline.rmw import (
     SI_KEY,
     RMWPipeline,
     ShardBackend,
+    pack_oi,
+    parse_oi,
 )
 from ceph_tpu.pipeline.stripe import StripeInfo
 from ceph_tpu.store import MemStore, Transaction
@@ -257,6 +259,8 @@ class _PG:
             perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.rmw",
             pglog=self.pglog,
         )
+        # writes stamp (epoch, tid) eversions into OI attrs
+        self.rmw.epoch = daemon.osdmap.epoch
         self.reads = ReadPipeline(
             self.sinfo, self.codec, self.backend,
             lambda oid: daemon._object_size(self, oid),
@@ -268,6 +272,7 @@ class _PG:
             self.rmw.hinfo,
             perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.recovery",
             user_attrs_fn=lambda oid: daemon._replicated_attrs(self, oid),
+            eversion_fn=lambda oid: daemon._authoritative_eversion(self, oid),
         )
 
 
@@ -442,6 +447,8 @@ class OSDDaemon:
                 if spec is None:
                     del self._pgs[key]
                     continue
+                # new epoch reaches surviving PGs' eversion stamps
+                pg.rmw.epoch = osdmap.epoch
                 if osdmap.pg_to_raw(pool, pgid) != pg.raw:
                     if pg.backfill_done:
                         # this PG's data already moved to the CRUSH
@@ -575,6 +582,9 @@ class OSDDaemon:
         vouch'). On failure the position reverts to a hole; the next
         map change retries."""
         try:
+            # Pristine member stamps, captured before any replay or
+            # refresh can overwrite them (see _member_listing).
+            member_listing = self._member_listing(pg, shard)
             if shard in pg.born_holes:
                 spec = self.osdmap.pools[pg.pool]
                 target_osd = pg.acting[shard]
@@ -618,6 +628,30 @@ class OSDDaemon:
                 pg.recovery.recover_from_log(pg.pglog, shard)
                 if not _dirty():
                     break
+            # Eversion divergence pass: log replay brings the member
+            # up to the authoritative history it MISSED; this catches
+            # what it should never have had — writes it applied that
+            # the cluster did not commit (divergent ex-primary). Any
+            # object whose stored stamp disagrees with authoritative
+            # history is rebuilt from survivors; objects unknown to
+            # authoritative state are removed.
+            target_osd = pg.acting[shard]
+            rollback, divergent_deletes = self._divergent_objects(
+                pg, shard, member_listing
+            )
+            for loc in sorted(rollback):
+                self.admit("recovery")
+                self.log.info(
+                    "pg", f"{pg.pool}/{pg.pgid}:", "divergent object",
+                    loc, "on shard", shard, "- rolling back"
+                )
+                pg.recovery.recover_object(loc, {shard})
+            for loc in sorted(divergent_deletes):
+                self.log.info(
+                    "pg", f"{pg.pool}/{pg.pgid}:", "divergent create",
+                    loc, "on shard", shard, "- removing"
+                )
+                self._push_delete(target_osd, loc, shard)
             # Admission happens under the op lock with a final clean
             # check: client writes (which also take _op_lock) cannot
             # append dirty entries between the check and the admit, so
@@ -723,6 +757,69 @@ class OSDDaemon:
             pg, oid
         )
 
+    def _authoritative_eversion(
+        self, pg: _PG, oid: str
+    ) -> "tuple[int, int] | None":
+        """The (epoch, tid) the object's latest committed write
+        stamped, from the live pipeline or my own shard's OI attr —
+        the eversion_t comparison source (osd_types.h)."""
+        ev = pg.rmw.object_eversion(oid)
+        if ev is not None:
+            return ev
+        ev = pg.pglog.last_eversion(oid)
+        if ev is not None and ev != (0, 0):
+            return ev
+        key = self._my_key(pg, oid)
+        if key is None:
+            return None
+        try:
+            _size, ev = parse_oi(self.store.getattr(key, OI_KEY))
+        except (FileNotFoundError, KeyError, ValueError):
+            return None
+        return None if ev == (0, 0) else ev
+
+    def _member_listing(self, pg: _PG, shard: int) -> list:
+        """The returning member's PG listing WITH its pristine
+        eversion stamps. Must be fetched BEFORE any log replay:
+        recovery pushes overwrite the member's OI stamps with the
+        authoritative eversion, which would mask divergence on any
+        object also written during the absence. Failures propagate —
+        the catch-up's except path reverts the position to a hole
+        rather than admitting an unjudged shard."""
+        target_osd = pg.acting[shard]
+        spec = self.osdmap.pools[pg.pool]
+        return self.peers.list_pg(
+            target_osd, spec.pool_id, spec.pg_num, pg.pgid
+        )
+
+    def _divergent_objects(
+        self, pg: _PG, shard: int, listing: list
+    ) -> tuple[set[str], set[str]]:
+        """(rollback, delete) for a returning member's shard: objects
+        whose stored (pre-replay) eversion does not match
+        authoritative history.
+
+        The PGLog::rewind_divergent_log role: a partitioned ex-primary
+        may hold locally-applied writes the cluster never committed —
+        its stamp differs from the authoritative one, so the shard's
+        bytes must be rebuilt from survivors (rollback), and objects
+        the authoritative state never heard of must be removed, or EC
+        decode would mix divergent bytes into every read."""
+        rollback: set[str] = set()
+        delete: set[str] = set()
+        for loc, si, _size, *ev in listing:
+            if si != shard:
+                continue  # old-layout leftovers: backfill/GC territory
+            member_ev = tuple(ev) if len(ev) == 2 else (0, 0)
+            if member_ev == (0, 0):
+                continue  # pre-eversion stamp: nothing to judge
+            auth = self._authoritative_eversion(pg, loc)
+            if auth is None:
+                delete.add(loc)
+            elif member_ev != auth:
+                rollback.add(loc)
+        return rollback, delete
+
     def _object_size(self, pg: _PG, oid: str) -> int:
         size = pg.rmw.object_size(oid)
         if size:
@@ -731,7 +828,7 @@ class OSDDaemon:
         if key is None:
             return 0
         try:
-            size = int(self.store.getattr(key, OI_KEY).decode())
+            size, ev = parse_oi(self.store.getattr(key, OI_KEY))
         except (FileNotFoundError, KeyError):
             return 0
         hinfo = None
@@ -739,7 +836,7 @@ class OSDDaemon:
             hinfo = HashInfo.from_bytes(self.store.getattr(key, HINFO_KEY))
         except (FileNotFoundError, KeyError, ValueError):
             pass
-        pg.rmw.prime_object(oid, size, hinfo)
+        pg.rmw.prime_object(oid, size, hinfo, eversion=ev)
         return size
 
     # -- dispatch -------------------------------------------------------
@@ -789,14 +886,14 @@ class OSDDaemon:
 
         oids = []
         for loc, si in self._scan_pg_keys(msg.pool_id, msg.pg_num, msg.pgid):
-            size = -1
+            size, ev = -1, (0, 0)
             try:
-                size = int(
-                    self.store.getattr(shard_key(loc, si), OI_KEY).decode()
+                size, ev = parse_oi(
+                    self.store.getattr(shard_key(loc, si), OI_KEY)
                 )
             except (FileNotFoundError, KeyError, ValueError):
                 pass
-            oids.append((loc, si, size))
+            oids.append((loc, si, size, ev[0], ev[1]))
         conn.send(PGListReply(msg.tid, msg.shard, oids))
 
     # -- client ops (the PrimaryLogPG::do_op role) ----------------------
@@ -1192,7 +1289,7 @@ class OSDDaemon:
             if osd not in self.peers.avail_shards():
                 continue
             try:
-                for oid, _si, size in self.peers.list_pg(
+                for oid, _si, size, *_ev in self.peers.list_pg(
                     osd, spec.pool_id, spec.pg_num, pgid
                 ):
                     oids[oid] = max(oids.get(oid, -1), size)
@@ -1262,7 +1359,10 @@ class OSDDaemon:
             txn.truncate(key, shard_len)
             if hinfo_bytes is not None:
                 txn.setattr(key, HINFO_KEY, hinfo_bytes)
-            txn.setattr(key, OI_KEY, str(size).encode())
+            txn.setattr(
+                key, OI_KEY,
+                pack_oi(size, self._authoritative_eversion(pg, oid) or (0, 0)),
+            )
             txn.setattr(key, SI_KEY, str(i).encode())
             for aname, aval in user_attrs.items():
                 txn.setattr(key, aname, aval)
@@ -1300,7 +1400,7 @@ class OSDDaemon:
                              # (shard keys can't be misread as current)
                 try:
                     held = [
-                        (loc, si) for loc, si, _sz in self.peers.list_pg(
+                        (loc, si) for loc, si, _sz, *_ev in self.peers.list_pg(
                             osd, spec.pool_id, spec.pg_num, pgid
                         )
                     ]

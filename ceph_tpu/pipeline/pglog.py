@@ -37,6 +37,8 @@ class LogEntry:
     shard_extents: dict[int, ExtentSet] = field(default_factory=dict)
     delete: bool = False
     xattrs: "dict[str, bytes | None] | None" = None
+    #: map epoch at append time; (epoch, tid) is the entry's eversion
+    epoch: int = 0
 
 
 class PGLog:
@@ -49,13 +51,26 @@ class PGLog:
 
     # -- write path hooks ----------------------------------------------
     def append(
-        self, tid: int, oid: str, shard_extents: dict[int, ExtentSet]
+        self, tid: int, oid: str, shard_extents: dict[int, ExtentSet],
+        epoch: int = 0,
     ) -> None:
         if self.entries and tid <= self.entries[-1].tid:
             raise ValueError(f"non-monotonic log append: tid {tid}")
         self.entries.append(
-            LogEntry(tid, oid, {s: es.copy() for s, es in shard_extents.items()})
+            LogEntry(
+                tid, oid,
+                {s: es.copy() for s, es in shard_extents.items()},
+                epoch=epoch,
+            )
         )
+
+    def last_eversion(self, oid: str) -> "tuple[int, int] | None":
+        """(epoch, tid) of the newest in-window entry touching the
+        oid — the authoritative eversion as far as the log knows."""
+        for e in reversed(self.entries):
+            if e.oid == oid:
+                return None if e.delete else (e.epoch, e.tid)
+        return None
 
     def append_delete(self, tid: int, oid: str) -> None:
         """Record a whole-object remove: a shard that misses it would

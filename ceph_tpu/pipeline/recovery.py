@@ -33,7 +33,7 @@ from .read import (
     get_min_avail_to_read_shards,
     reconstruct_shards,
 )
-from .rmw import HINFO_KEY, OI_KEY, SI_KEY
+from .rmw import HINFO_KEY, OI_KEY, SI_KEY, pack_oi
 from .shard_map import ShardExtentMap
 from .stripe import StripeInfo
 
@@ -83,12 +83,16 @@ class RecoveryBackend:
         hinfo_fn,
         perf_name: str = "ec_recovery",
         user_attrs_fn=None,
+        eversion_fn=None,
     ) -> None:
         self.sinfo = sinfo
         self.codec = codec
         self.backend = backend
         self.size_fn = size_fn
         self.hinfo_fn = hinfo_fn
+        #: oid -> authoritative (epoch, tid) to stamp into pushed OI
+        #: attrs (None = stamp the null eversion)
+        self.eversion_fn = eversion_fn
         #: oid -> {attr name: bytes} of USER attrs to restore with a
         #: push (the primary's copy — user xattrs replicate everywhere)
         self.user_attrs_fn = user_attrs_fn
@@ -279,6 +283,17 @@ class RecoveryBackend:
         )
         for shard in sorted(op.missing):
             txn = Transaction().touch(op.oid)
+            # Truncate to the authoritative shard length: a DIVERGENT
+            # target (eversion rollback) may hold a LONGER stale copy
+            # whose garbage tail would otherwise survive the rebuild
+            # (absent-shard pushes truncate to a no-op).
+            txn.truncate(
+                op.oid,
+                max(
+                    self.sinfo.object_size_to_exact_shard_size(size, shard),
+                    0,
+                ),
+            )
             for start, end in op.want.get(shard, ExtentSet()):
                 buf = bytes(op.result.get(shard, start, end - start))
                 txn.write(op.oid, start, buf)
@@ -288,7 +303,10 @@ class RecoveryBackend:
             # identity attrs, as the original write txn carried them:
             # size for new-primary takeover, shard index for the
             # misplacement guard
-            txn.setattr(op.oid, OI_KEY, str(size).encode())
+            ev = (
+                self.eversion_fn(op.oid) if self.eversion_fn else None
+            ) or (0, 0)
+            txn.setattr(op.oid, OI_KEY, pack_oi(size, ev))
             txn.setattr(op.oid, SI_KEY, str(shard).encode())
             for aname, aval in user_attrs.items():
                 txn.setattr(op.oid, aname, aval)

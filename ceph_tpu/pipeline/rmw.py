@@ -48,6 +48,30 @@ HINFO_KEY = "hinfo_key"  # ECUtil.cc:1179
 #: txn (the object_info_t "_" attr role) so a NEW primary can recover
 #: sizes after failover instead of trusting in-memory state.
 OI_KEY = "oi"
+
+
+def pack_oi(size: int, eversion: tuple[int, int] = (0, 0)) -> bytes:
+    """object_info_t attr payload: ro size + last-write eversion.
+
+    The eversion is the reference's ``eversion_t`` (osd_types.h) —
+    (map epoch, op version) stamped atomically with every sub-write,
+    so peering can tell a shard whose content matches authoritative
+    history from one that diverged (a partitioned ex-primary's
+    locally-applied writes)."""
+    return f"{size}:{eversion[0]}:{eversion[1]}".encode()
+
+
+def parse_oi(raw: bytes) -> tuple[int, tuple[int, int]]:
+    """(size, eversion); bare-size payloads (pre-eversion format)
+    parse with the null eversion (0, 0) = 'unknown'. Any other shape
+    is corrupt and raises ValueError (the error every caller already
+    handles)."""
+    parts = raw.decode().split(":")
+    if len(parts) == 1:
+        return int(parts[0]), (0, 0)
+    if len(parts) != 3:
+        raise ValueError(f"corrupt OI payload: {raw!r}")
+    return int(parts[0]), (int(parts[1]), int(parts[2]))
 #: shard-index attr: which logical EC shard these bytes are. Read
 #: paths compare it against the position they are asking for, so a
 #: CRUSH remap can never silently serve shard j's bytes as shard i
@@ -305,6 +329,11 @@ class RMWPipeline:
         self._inflight: "OrderedDict[int, ClientOp]" = OrderedDict()
         self._object_sizes: dict[str, int] = {}
         self._hinfo: dict[str, HashInfo] = {}
+        #: current map epoch, stamped (with the op tid) into every
+        #: write's OI attr as the object's eversion; the owning daemon
+        #: refreshes it on map change
+        self.epoch = 0
+        self._eversions: dict[str, tuple[int, int]] = {}
         #: oid -> backend-read failure awaiting its op (degraded RMW
         #: read failed; the op aborts in _cache_ready, in order)
         self._read_errors: dict[str, Exception] = {}
@@ -402,6 +431,7 @@ class RMWPipeline:
                 _op.written = ShardExtentMap(self.sinfo)
                 self._object_sizes.pop(oid, None)
                 self._hinfo.pop(oid, None)
+                self._eversions.pop(oid, None)
                 for shard in sorted(live):
                     # touch+remove: no-op on shards that never got the
                     # object (a hole at write time)
@@ -475,8 +505,13 @@ class RMWPipeline:
     def object_size(self, oid: str) -> int:
         return self._object_sizes.get(oid, 0)
 
+    def object_eversion(self, oid: str) -> tuple[int, int] | None:
+        """Last committed write's (epoch, tid) stamp, if known."""
+        return self._eversions.get(oid)
+
     def prime_object(
-        self, oid: str, size: int, hinfo: HashInfo | None = None
+        self, oid: str, size: int, hinfo: HashInfo | None = None,
+        eversion: tuple[int, int] | None = None,
     ) -> None:
         """Seed per-object state recovered from stored attrs (OI_KEY /
         HINFO_KEY) — the new-primary takeover path: a freshly elected
@@ -484,6 +519,8 @@ class RMWPipeline:
         self._object_sizes[oid] = size
         if hinfo is not None:
             self._hinfo[oid] = hinfo
+        if eversion is not None and eversion != (0, 0):
+            self._eversions[oid] = eversion
 
     def hinfo(self, oid: str) -> HashInfo | None:
         return self._hinfo.get(oid)
@@ -596,6 +633,7 @@ class RMWPipeline:
 
         self._generate_transactions(op, new_map, new_size)
         self._object_sizes[op.oid] = new_size
+        self._eversions[op.oid] = (self.epoch, op.tid)
 
     def _get_hinfo(self, oid: str) -> HashInfo:
         if oid not in self._hinfo:
@@ -637,7 +675,9 @@ class RMWPipeline:
                 txn.write(op.oid, start, buf)
                 written.insert(shard, start, np.frombuffer(buf, np.uint8))
             txn.setattr(op.oid, HINFO_KEY, hinfo_bytes)
-            txn.setattr(op.oid, OI_KEY, str(new_size).encode())
+            txn.setattr(
+                op.oid, OI_KEY, pack_oi(new_size, (self.epoch, op.tid))
+            )
             txn.setattr(op.oid, SI_KEY, str(shard).encode())
             txns.append((shard, txn))
         if self.pglog is not None:
@@ -645,6 +685,7 @@ class RMWPipeline:
                 op.tid,
                 op.oid,
                 {s: written.get_extent_set(s) for s in written.shards()},
+                epoch=self.epoch,
             )
         # build every txn before the first dispatch: a synchronous ack
         # (local stores) must see the complete written map

@@ -510,3 +510,85 @@ def test_resent_remove_replays_cached_result(cluster):
     r_replay = dw._execute_client_op(w1_again)
     assert r_replay.error == "" and r_replay.size == r_w1.size
     assert io.read("wobj") == b, "resent write must not re-apply"
+
+
+def test_divergent_member_rolled_back_on_return(cluster):
+    """Eversion divergence (the rewind_divergent_log role): a member
+    that applied writes the cluster never committed — the partitioned
+    ex-primary case — returns through the log-vouch path. Its stamp
+    disagrees with authoritative history, so the shard's bytes are
+    rebuilt from survivors, and a phantom object only it holds is
+    removed. Without eversions this was indistinguishable from a
+    clean catch-up (the CAPABILITIES gap paragraph this test closes)."""
+    import time
+
+    from ceph_tpu.pipeline.rmw import OI_KEY, pack_oi, parse_oi
+    from ceph_tpu.store import Transaction
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("obj", payload(5_000, seed=1))
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    member = acting[1]
+    # Pick the phantom's identity while the member is still up: it
+    # must sit where the member actually serves a shard (divergence
+    # scans judge per-PG, per-position).
+    # ... at a NON-primary position: a returning member is judged by
+    # its PG's primary; a returning ex-primary judging itself needs
+    # the full peering log election (documented limitation).
+    phantom_oid = next(
+        f"phantom{i}" for i in range(100)
+        if member in mon.osdmap.object_to_acting("ecpool", f"phantom{i}")[1:]
+    )
+    ppos = mon.osdmap.object_to_acting("ecpool", phantom_oid).index(member)
+    mon.osd_down(member)
+    # The in-absence committed write covers only the object's HEAD:
+    # log replay will push (and re-stamp) just those extents, so only
+    # the pre-replay stamp comparison can catch garbage elsewhere in
+    # the shard (replay-overwrites-the-stamp masking case).
+    head = payload(700, seed=2)
+    io.write("obj", head, offset=0)
+    authoritative = head + payload(5_000, seed=1)[700:]
+
+    # Simulate divergence on the downed member's store: it "applied"
+    # a write nobody committed (garbage bytes + a stamp that is not
+    # in authoritative history), and created an object only it has.
+    store = daemons[member].store
+    pool_id = mon.osdmap.pools["ecpool"].pool_id
+    keys = [
+        k for k in store.list_objects()
+        if k.startswith(f"{pool_id}:obj#s")
+    ]
+    assert keys, "member should hold a shard of obj"
+    key = keys[0]
+    _size, ev = parse_oi(store.getattr(key, OI_KEY))
+    good_shard = store.read(key)
+    store.queue_transactions(
+        Transaction()
+        .write(key, 0, b"\xde\xad" * 64)
+        .setattr(key, OI_KEY, pack_oi(_size, (ev[0], ev[1] + 1000)))
+    )
+    phantom = f"{pool_id}:{phantom_oid}#s{ppos}"
+    store.queue_transactions(
+        Transaction()
+        .touch(phantom)
+        .write(phantom, 0, b"ghost-bytes")
+        .setattr(phantom, OI_KEY, pack_oi(11, (ev[0], ev[1] + 2000)))
+        .setattr(phantom, "si", str(ppos).encode())
+    )
+
+    mon.osd_boot(member, daemons[member].addr)  # log-vouch return
+
+    end = time.monotonic() + 15
+    while time.monotonic() < end:
+        diverged = store.exists(key) and store.read(key)[:4] == b"\xde\xad\xde\xad"
+        if not diverged and not store.exists(phantom):
+            break
+        time.sleep(0.05)
+    assert store.exists(key)
+    assert store.read(key)[:4] != b"\xde\xad\xde\xad", (
+        "divergent shard bytes survived catch-up"
+    )
+    assert not store.exists(phantom), "phantom object survived catch-up"
+    # and the client still reads authoritative content
+    assert io.read("obj") == authoritative
