@@ -126,6 +126,24 @@ def cmd_vstart(cl: Cluster, args) -> int:
             )
     print(f"cluster up: {len(cl.daemons)} osds, epoch "
           f"{cl.mon.osdmap.epoch}, dir {cl.root}")
+    if getattr(args, "exporter", None) is not None:
+        import time as _time
+
+        from ceph_tpu.utils.exporter import Exporter
+
+        exp = Exporter()
+        host, port = exp.start(port=args.exporter)
+        print(f"metrics: http://{host}:{port}/metrics (ctrl-c to stop)")
+        # The CLI is one-command-and-exit; an exporter only makes
+        # sense while the cluster process lives, so this invocation
+        # blocks and serves until interrupted.
+        try:
+            while True:
+                _time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            exp.stop()
     return 0
 
 
@@ -375,6 +393,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="OSD backend for NEW osds: FileStore tree or BlockStore "
              "raw device (default: whatever the cluster already uses, "
              "else file)",
+    )
+    s.add_argument(
+        "--exporter", type=int, nargs="?", const=0, default=None,
+        metavar="PORT",
+        help="serve Prometheus /metrics (0 or no value = ephemeral "
+             "port; the src/exporter + mgr/prometheus analog)",
     )
     s.set_defaults(fn=cmd_vstart)
 

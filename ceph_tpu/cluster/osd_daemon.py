@@ -585,6 +585,7 @@ class OSDDaemon:
             # Pristine member stamps, captured before any replay or
             # refresh can overwrite them (see _member_listing).
             member_listing = self._member_listing(pg, shard)
+            refreshed: set[str] = set()
             if shard in pg.born_holes:
                 spec = self.osdmap.pools[pg.pool]
                 target_osd = pg.acting[shard]
@@ -615,6 +616,7 @@ class OSDDaemon:
                     pg.recovery.recover_object(
                         loc, {shard}, size=size_hint
                     )
+                    refreshed.add(loc)
                 pg.born_holes.discard(shard)
             def _dirty() -> bool:
                 return bool(
@@ -639,6 +641,9 @@ class OSDDaemon:
             rollback, divergent_deletes = self._divergent_objects(
                 pg, shard, member_listing
             )
+            # the born-hole refresh already rebuilt these (their
+            # pre-refresh stamps are stale by construction)
+            rollback -= refreshed
             for loc in sorted(rollback):
                 self.admit("recovery")
                 self.log.info(
@@ -829,7 +834,7 @@ class OSDDaemon:
             return 0
         try:
             size, ev = parse_oi(self.store.getattr(key, OI_KEY))
-        except (FileNotFoundError, KeyError):
+        except (FileNotFoundError, KeyError, ValueError):
             return 0
         hinfo = None
         try:

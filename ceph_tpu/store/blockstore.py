@@ -4,11 +4,13 @@ Mirrors BlueStore's structural shape (src/os/bluestore/BlueStore.cc):
 
 - **one flat device** (a preallocated file standing in for the raw
   block device) holds all object data as allocator-granted extents;
-- **metadata lives beside the data, not in a filesystem**: an
-  in-memory object table (oid → blob list + attrs) journaled through
-  the shared crc-framed WAL (the RocksDB-WAL-via-BlueFS role) with
-  periodic full checkpoints (the sst role); recovery = load checkpoint
-  + replay WAL tail;
+- **metadata lives in the embedded KV store, not in a filesystem**:
+  onodes (oid → blob list + attrs) are rows in ``store.kvstore``
+  under the "O" prefix — the BlueStore-onodes-in-RocksDB architecture
+  (BlueStore.cc keeps onodes/omap in RocksDB column families). Each
+  transaction batch commits ONE KV batch containing only the onodes
+  it touched (delta commits, not a full-table dump); the KV store's
+  own WAL + snapshot compaction provide recovery;
 - **allocator-managed free space** (Btree/Bitmap/Hybrid — the
   reference's allocator family) rebuilt on open from the object table
   (the FreelistManager inversion: used = union of live blobs);
@@ -38,7 +40,13 @@ from ceph_tpu.checksum.host import crc32c as _crc
 
 from . import framed_log
 from .allocator import ALLOCATORS, AllocError
+from .kvstore import KeyValueDB
 from .transaction import Op, OpKind, Transaction
+
+#: KV prefixes (the column-family layout, BlueStore PREFIX_* style):
+#: O = onodes, S = store-wide state (committed seq)
+PREFIX_ONODE = "O"
+PREFIX_STATE = "S"
 
 CSUM_SEED = 0xFFFFFFFF
 
@@ -112,11 +120,10 @@ class BlockStore:
         self.csum_block = csum_block
         self.checkpoint_every = checkpoint_every
         self.device_path = os.path.join(root, "block")
-        self.wal_path = os.path.join(root, "meta.wal")
-        self.ckpt_path = os.path.join(root, "meta.ckpt")
+        self.wal_path = os.path.join(root, "meta.wal")      # legacy
+        self.ckpt_path = os.path.join(root, "meta.ckpt")    # legacy
         self._lock = threading.Lock()
         self.committed_seq = 0
-        self._wal_records = 0
         if not os.path.exists(self.device_path):
             with open(self.device_path, "wb") as f:
                 f.truncate(size)
@@ -124,76 +131,72 @@ class BlockStore:
         self._dev = open(self.device_path, "r+b")
         self.device_size = os.path.getsize(self.device_path)
         self._objects: dict[str, _Onode] = {}
+        # distinct "kv" namespace: the legacy format owned meta.wal
+        self._kvdb = KeyValueDB(
+            root, name="kv", compact_every=checkpoint_every
+        )
         self._load_metadata()
         self.allocator = ALLOCATORS[allocator](block_size)
         self._rebuild_freelist()
 
-    # -- metadata persistence (checkpoint + WAL replay) ----------------
+    # -- metadata persistence (onodes as KV rows) ----------------------
     def _load_metadata(self) -> None:
+        self._import_legacy_metadata()
+        raw_seq = self._kvdb.get(PREFIX_STATE, "seq")
+        self.committed_seq = int(raw_seq) if raw_seq else 0
+        self._objects = {
+            oid: _Onode.from_obj(json.loads(raw))
+            for oid, raw in self._kvdb.iterate(PREFIX_ONODE)
+        }
+
+    def _import_legacy_metadata(self) -> None:
+        """One-shot upgrade from the pre-KV format (full-table JSON
+        checkpoint + WAL records) into KV rows — the format-migration
+        discipline BlueStore applies between its own metadata
+        revisions. Legacy files are removed once their content is
+        durable in the KV store."""
+        if not (
+            os.path.exists(self.ckpt_path) or os.path.exists(self.wal_path)
+        ):
+            return
+        seq, objects = 0, {}
         if os.path.exists(self.ckpt_path):
             with open(self.ckpt_path) as f:
                 snap = json.load(f)
-            self.committed_seq = snap["seq"]
-            self._objects = {
-                oid: _Onode.from_obj(o) for oid, o in snap["objects"].items()
-            }
+            seq, objects = snap["seq"], dict(snap["objects"])
         for payload in framed_log.replay(self.wal_path):
             rec = json.loads(payload.decode())
-            if rec["seq"] <= self.committed_seq:
-                continue  # already in the checkpoint
-            self._objects = {
-                oid: _Onode.from_obj(o) for oid, o in rec["objects"].items()
-            }
-            self.committed_seq = rec["seq"]
+            if rec["seq"] > seq:
+                seq, objects = rec["seq"], dict(rec["objects"])
+        txn = self._kvdb.transaction()
+        txn.rmkeys_by_prefix(PREFIX_ONODE)
+        for oid, obj in objects.items():
+            txn.set(PREFIX_ONODE, oid, json.dumps(obj).encode())
+        txn.set(PREFIX_STATE, "seq", str(seq).encode())
+        self._kvdb.submit_transaction(txn)
+        self._kvdb.compact()  # durable snapshot before dropping legacy
+        # WAL first: if we crash between the removes, a surviving ckpt
+        # re-imports the same content (idempotent); a surviving EMPTY
+        # wal alone would re-import nothing and wipe the rows.
+        for path in (self.wal_path, self.ckpt_path):
+            if os.path.exists(path):
+                os.remove(path)
 
-    def _checkpoint(self) -> None:
-        tmp = self.ckpt_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {
-                    "seq": self.committed_seq,
-                    "objects": {
-                        oid: o.to_obj() for oid, o in self._objects.items()
-                    },
-                },
-                f,
-            )
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.ckpt_path)
-        # Durability ordering (the FileStore.queue_transactions
-        # discipline): the rename must be on disk BEFORE the WAL
-        # truncate is, else a power cut can keep the truncate but not
-        # the rename and lose acked transactions on reopen.
-        dirfd = os.open(os.path.dirname(self.ckpt_path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
-        with open(self.wal_path, "wb") as wal:
-            wal.flush()
-            os.fsync(wal.fileno())  # WAL fully absorbed
-        self._wal_records = 0
-
-    def _commit_metadata(self) -> None:
-        """One WAL record per transaction batch: the full (small)
-        object table — metadata is tiny next to data, and a full
-        record keeps replay trivial and torn-tail safe."""
+    def _commit_metadata(self, staged: "dict[str, _Onode | None]") -> None:
+        """One KV batch per transaction batch, containing ONLY the
+        onodes this batch touched (delta commits — the reason the
+        metadata tier is a KV store and not a journaled table dump)."""
         self.committed_seq += 1
-        framed_log.append(
-            self.wal_path,
-            json.dumps(
-                {
-                    "seq": self.committed_seq,
-                    "objects": {
-                        oid: o.to_obj() for oid, o in self._objects.items()
-                    },
-                }
-            ).encode(),
-        )
-        self._wal_records += 1
-        if self._wal_records >= self.checkpoint_every:
-            self._checkpoint()
+        txn = self._kvdb.transaction()
+        for oid, onode in staged.items():
+            if onode is None:
+                txn.rmkey(PREFIX_ONODE, oid)
+            else:
+                txn.set(
+                    PREFIX_ONODE, oid, json.dumps(onode.to_obj()).encode()
+                )
+        txn.set(PREFIX_STATE, "seq", str(self.committed_seq).encode())
+        self._kvdb.submit_transaction(txn)
 
     def _rebuild_freelist(self) -> None:
         """FreelistManager inversion: free = device minus live blobs."""
@@ -254,7 +257,7 @@ class BlockStore:
                     self._objects.pop(oid, None)
                 else:
                     self._objects[oid] = onode
-            self._commit_metadata()
+            self._commit_metadata(staged)
             # old blocks join the freelist only AFTER the metadata that
             # stops referencing them is durable (COW discipline)
             self.allocator.release(freed)
@@ -488,7 +491,7 @@ class BlockStore:
 
     def close(self) -> None:
         with self._lock:
-            self._checkpoint()
+            self._kvdb.compact()
             self._dev.close()
 
     def __repr__(self) -> str:

@@ -190,9 +190,9 @@ def test_blockstore_cow_overwrite_keeps_old_until_commit(tmp_path):
 def test_blockstore_checkpoint_absorbs_wal(tmp_path):
     root = str(tmp_path / "bs")
     st = BlockStore(root, size=1 << 22, checkpoint_every=4)
-    for i in range(6):  # crosses the checkpoint threshold
+    for i in range(6):  # crosses the KV compaction threshold
         st.queue_transactions(Transaction().write(f"o{i}", 0, b"z" * 100))
-    assert os.path.exists(os.path.join(root, "meta.ckpt"))
+    assert os.path.exists(os.path.join(root, "kv.snap"))
     st2 = BlockStore(root, size=1 << 22)
     assert st2.list_objects() == [f"o{i}" for i in range(6)]
     for i in range(6):
