@@ -326,6 +326,45 @@ def cmd_perf(cl: Cluster, args) -> int:
     return 0
 
 
+def cmd_health(cl: Cluster, args) -> int:
+    """The `ceph health detail` role (mgr health model)."""
+    from ceph_tpu.cluster import Manager
+
+    report = Manager(cl.mon).health()
+    print(report["status"])
+    for name, check in sorted(report["checks"].items()):
+        print(f"  [{check['severity'].upper()}] {name}: {check['detail']}")
+    return 0 if report["status"] == "HEALTH_OK" else 1
+
+
+def cmd_autoscale_status(cl: Cluster, args) -> int:
+    """The `ceph osd pool autoscale-status` role."""
+    from ceph_tpu.cluster import Manager
+
+    for row in Manager(cl.mon).autoscale_status():
+        flag = " (warn)" if row["warn"] else ""
+        print(
+            f"pool {row['pool']!r}: pg_num {row['pg_num']}, "
+            f"ideal ~{row['ideal_pg_num']}{flag}"
+        )
+    return 0
+
+
+def cmd_balance(cl: Cluster, args) -> int:
+    """One balancer run (the `ceph balancer execute` role): reweight
+    until the target PG-shard distribution settles, then wait for the
+    resulting backfills to finish."""
+    from ceph_tpu.cluster import Manager
+
+    mgr = Manager(cl.mon)
+    before = mgr.pg_shard_counts()
+    rounds = mgr.balance()
+    after = mgr.pg_shard_counts()
+    cl.settle(timeout=args.timeout)
+    print(f"balanced in {rounds} rounds: {before} -> {after}")
+    return 0
+
+
 def cmd_bench(cl: Cluster, args) -> int:
     """The `rados bench` role: parallel writes then reads via aio
     (objects spread over primaries; concurrency is the point)."""
@@ -443,6 +482,16 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("scrub")
     s.add_argument("--repair", action="store_true")
     s.set_defaults(fn=cmd_scrub)
+
+    sub.add_parser(
+        "health", help="structured health report (mgr health model)"
+    ).set_defaults(fn=cmd_health)
+    sub.add_parser(
+        "autoscale-status", help="pg_autoscaler recommendations"
+    ).set_defaults(fn=cmd_autoscale_status)
+    s = sub.add_parser("balance", help="run the balancer (mgr module)")
+    s.add_argument("--timeout", type=float, default=60.0)
+    s.set_defaults(fn=cmd_balance)
 
     s = sub.add_parser("perf", help="dump perf counters (perf dump)")
     s.add_argument("--grep", default="", help="substring filter")

@@ -15,12 +15,14 @@ ops via the map (src/osdc/Objecter.cc). This package is the analog:
 """
 
 from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec, SHARD_NONE
+from .mgr import Manager
 from .monitor import CommandError, Monitor
 from .objecter import IoCtx, NoPrimary, Objecter, RadosClient
 from .osd_daemon import OSDDaemon
 from .striper import StripedIoCtx
 
 __all__ = [
+    "Manager",
     "CommandError",
     "Incremental",
     "IoCtx",

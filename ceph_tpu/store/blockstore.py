@@ -159,6 +159,8 @@ class BlockStore:
             os.path.exists(self.ckpt_path) or os.path.exists(self.wal_path)
         ):
             return
+        raw_kv_seq = self._kvdb.get(PREFIX_STATE, "seq")
+        kv_seq = int(raw_kv_seq) if raw_kv_seq else -1
         seq, objects = 0, {}
         if os.path.exists(self.ckpt_path):
             with open(self.ckpt_path) as f:
@@ -168,6 +170,15 @@ class BlockStore:
             rec = json.loads(payload.decode())
             if rec["seq"] > seq:
                 seq, objects = rec["seq"], dict(rec["objects"])
+        if kv_seq >= seq:
+            # An earlier migration already absorbed this content (we
+            # crashed between the two removes below): importing again
+            # from a STALE checkpoint would rewind the KV rows past
+            # acked transactions. Just finish the cleanup.
+            for path in (self.wal_path, self.ckpt_path):
+                if os.path.exists(path):
+                    os.remove(path)
+            return
         txn = self._kvdb.transaction()
         txn.rmkeys_by_prefix(PREFIX_ONODE)
         for oid, obj in objects.items():

@@ -1,7 +1,11 @@
 """Runtime utilities: platform selection, perf counters, config,
 tracing — the ``src/common/`` analog layer."""
 
-from .platform import honor_platform_env
+from .platform import (
+    apply_debug_modes,
+    honor_platform_env,
+    install_debug_observer,
+)
 from .perf_counters import (
     PerfCounters,
     PerfCountersBuilder,
@@ -13,7 +17,9 @@ from .trace import Tracer, tracer
 from .admin_socket import AdminSocket, admin_socket
 
 __all__ = [
+    "apply_debug_modes",
     "honor_platform_env",
+    "install_debug_observer",
     "PerfCounters",
     "PerfCountersBuilder",
     "PerfCountersCollection",

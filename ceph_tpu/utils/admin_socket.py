@@ -80,7 +80,12 @@ admin_socket = AdminSocket()
 def _register_builtins(sock: AdminSocket) -> None:
     from ceph_tpu.utils.config import config
     from ceph_tpu.utils.perf_counters import perf_collection
+    from ceph_tpu.utils.platform import install_debug_observer
     from ceph_tpu.utils.trace import tracer
+
+    # `config set debug_nan_check true` over the admin socket flips
+    # the jax debug flags live (sanitizer-toggle analog, SURVEY §5.2)
+    install_debug_observer()
 
     sock.register(
         "perf dump", lambda: perf_collection.dump(),

@@ -58,12 +58,9 @@ def render_exposition(
         entry = metrics.setdefault(metric, (typ, []))
         entry[1].append((labels, value))
 
-    with coll._lock:
-        sets = dict(coll._sets)
-    for set_name, pc in sorted(sets.items()):
+    for set_name, (schema, dump) in coll.snapshot().items():
         label = f'set="{_escape_label(set_name)}"'
-        dump = pc.dump()
-        for key, spec in pc._schema.items():
+        for key, spec in schema.items():
             metric = f"{_PREFIX}_{_sanitize(key)}"
             v = dump[key]
             t = spec["type"]

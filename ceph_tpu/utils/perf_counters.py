@@ -165,6 +165,14 @@ class PerfCountersCollection:
         with self._lock:
             return {name: pc.dump() for name, pc in sorted(self._sets.items())}
 
+    def snapshot(self) -> dict[str, tuple[dict, dict]]:
+        """name -> (schema, dumped values), sorted — the exporter
+        surface (schema carries each counter's type and histogram
+        bucket bounds)."""
+        with self._lock:
+            sets = sorted(self._sets.items())
+        return {name: (dict(pc._schema), pc.dump()) for name, pc in sets}
+
 
 # Process-global collection, served by the admin socket's "perf dump".
 perf_collection = PerfCountersCollection()

@@ -13,6 +13,27 @@ from __future__ import annotations
 import os
 
 
+def apply_debug_modes() -> None:
+    """Map the debug_* config options onto JAX debug flags — the
+    runtime analog of the reference's WITH_ASAN/WITH_TSAN compile-time
+    sanitizer toggles (CMakeLists.txt:673-690; SURVEY.md §5.2). Safe
+    to call any time; also installed as a config observer so
+    ``config set debug_nan_check true`` takes effect live."""
+    import jax
+
+    from ceph_tpu.utils.config import config
+
+    jax.config.update("jax_debug_nans", config.get("debug_nan_check"))
+    jax.config.update("jax_disable_jit", config.get("debug_disable_jit"))
+
+
+def install_debug_observer() -> None:
+    """Re-apply debug modes whenever a debug_* option changes."""
+    from ceph_tpu.utils.config import config
+
+    config.add_observer("debug_", lambda _name, _value: apply_debug_modes())
+
+
 def honor_platform_env() -> None:
     """Make jax_platforms config match an explicit JAX_PLATFORMS=cpu.
 

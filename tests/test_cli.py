@@ -159,3 +159,25 @@ def test_cli_scrub_and_bench(cdir, tmp_path, capsys):
     assert "write_MBps" in out
     out = run(capsys, "-d", cdir, "scrub")
     assert "0 inconsistent" in out
+
+
+def test_cli_mgr_commands(cdir, tmp_path, capsys):
+    """health / autoscale-status / balance: the mgr-module operator
+    surface over the persistent dev cluster."""
+    run(capsys, "-d", cdir, "vstart", "--osds", "3")
+    run(capsys, "-d", cdir, "profile-set", "rs21",
+        "plugin=isa", "k=2", "m=1")
+    run(capsys, "-d", cdir, "pool-create", "p", "64", "rs21")
+    out = run(capsys, "-d", cdir, "health")
+    assert out.splitlines()[0] == "HEALTH_OK"
+    out = run(capsys, "-d", cdir, "autoscale-status")
+    assert "pool 'p'" in out and "ideal" in out
+    out = run(capsys, "-d", cdir, "balance", "--timeout", "10")
+    assert "balanced in" in out
+    # a downed OSD must degrade health (and health exits nonzero)
+    run(capsys, "-d", cdir, "osd-down", "2")
+    rc = main(["-d", cdir, "health"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HEALTH_WARN" in out or "HEALTH_ERR" in out
+    assert "OSD_DOWN" in out

@@ -178,3 +178,28 @@ class TestBlockStoreMigration:
         st2.close()
         st3 = BlockStore(root, size=1 << 22)  # and it stays consistent
         assert st3.read("obj") == b"D" * 5000
+
+    def test_stale_legacy_checkpoint_cannot_rewind(self, tmp_path):
+        """Crash window: a migration that removed meta.wal but not
+        meta.ckpt leaves a STALE checkpoint behind; reopening must
+        keep the newer KV rows, not re-import the old snapshot."""
+        from ceph_tpu.store import BlockStore, Transaction
+
+        root = str(tmp_path / "bs")
+        st = BlockStore(root, size=1 << 22)
+        st.queue_transactions(Transaction().write("old", 0, b"O" * 100))
+        stale = {
+            "seq": st.committed_seq,
+            "objects": {
+                oid: json.loads(raw) for oid, raw in st._kvdb.iterate("O")
+            },
+        }
+        st.queue_transactions(Transaction().write("new", 0, b"N" * 100))
+        st.close()
+        # simulate the crash leftovers: stale ckpt, no wal
+        with open(os.path.join(root, "meta.ckpt"), "w") as f:
+            json.dump(stale, f)
+        st2 = BlockStore(root, size=1 << 22)
+        assert st2.read("new") == b"N" * 100   # survived
+        assert st2.read("old") == b"O" * 100
+        assert not os.path.exists(os.path.join(root, "meta.ckpt"))

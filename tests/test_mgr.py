@@ -1,0 +1,109 @@
+"""Manager daemon (ceph-mgr analog): balancer convergence on a skewed
+cluster, pg_autoscaler recommendations + warn thresholds, and the
+structured health report (OSD_DOWN / PG_DEGRADED / PG_UNAVAILABLE).
+"""
+
+import pytest
+
+from ceph_tpu.cluster import Manager, Monitor
+
+
+def mkcluster(n=6, pools=(("p1", 8, 2, 1),)):
+    mon = Monitor()
+    for i in range(n):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+        mon.osd_boot(i, ("127.0.0.1", 7000 + i))
+    for name, pgs, k, m in pools:
+        prof = f"prof_{name}"
+        mon.osd_erasure_code_profile_set(
+            prof, {"plugin": "isa", "k": str(k), "m": str(m)}
+        )
+        mon.osd_pool_create(name, pgs, prof)
+    return mon
+
+
+class TestBalancer:
+    def test_balanced_cluster_is_left_alone(self):
+        mon = mkcluster()
+        mgr = Manager(mon)
+        counts = mgr.pg_shard_counts()
+        mean = sum(counts.values()) / len(counts)
+        if all(
+            abs(c - mean) / mean <= mgr.balance_threshold
+            for c in counts.values()
+        ):
+            assert mgr.balance_once() == {}
+
+    def test_skewed_weights_get_balanced(self):
+        """Start one OSD at 4x weight: it hoards PG shards; the
+        balancer's reweights must shrink the spread."""
+        mon = mkcluster(n=6, pools=[("p1", 32, 2, 1)])
+        mon.osd_reweight(0, 4.0)
+        mgr = Manager(mon)
+        before = mgr.pg_shard_counts()
+        rounds = mgr.balance(max_rounds=30)
+        after = mgr.pg_shard_counts()
+        assert rounds > 0                    # it had work to do
+        assert after[0] < before[0]          # the hoarder shed shards
+        spread = max(after.values()) - min(after.values())
+        assert spread <= max(before.values()) - min(before.values())
+        # the hoarder's weight came down
+        assert mon.osdmap.osds[0].weight < 4.0
+
+    def test_weights_never_fall_below_floor(self):
+        mon = mkcluster(n=3, pools=[("p1", 16, 2, 1)])
+        mgr = Manager(mon, min_weight=0.25)
+        for _ in range(50):
+            mgr.balance_once()
+        assert all(
+            info.weight >= 0.25 for info in mon.osdmap.osds.values()
+        )
+
+
+class TestAutoscaler:
+    def test_rows_shape_and_ideal_power_of_two(self):
+        mon = mkcluster(n=6, pools=[("p1", 8, 2, 1), ("p2", 8, 4, 2)])
+        rows = Manager(mon).autoscale_status()
+        assert [r["pool"] for r in rows] == ["p1", "p2"]
+        for r in rows:
+            assert r["ideal_pg_num"] & (r["ideal_pg_num"] - 1) == 0
+
+    def test_tiny_pg_num_warns(self):
+        mon = mkcluster(n=6, pools=[("p1", 1, 2, 1)])
+        (row,) = Manager(mon).autoscale_status()
+        assert row["warn"]
+
+    def test_sane_pg_num_quiet(self):
+        mon = mkcluster(n=6, pools=[("p1", 64, 2, 1)])
+        (row,) = Manager(mon).autoscale_status()
+        # ideal = 6*100 / 3 = 200 -> 2^8 = 256; 64 within 4x slack
+        assert not row["warn"]
+
+
+class TestHealth:
+    def test_healthy(self):
+        mon = mkcluster(n=6, pools=[("p1", 64, 2, 1)])
+        h = Manager(mon).health()
+        assert h["status"] == "HEALTH_OK"
+        assert h["checks"] == {}
+
+    def test_down_osd_degrades(self):
+        mon = mkcluster(n=6, pools=[("p1", 64, 2, 1)])
+        mon.osd_down(5)
+        h = Manager(mon).health()
+        assert h["status"] == "HEALTH_WARN"
+        assert "OSD_DOWN" in h["checks"]
+        assert "PG_DEGRADED" in h["checks"]
+
+    def test_below_k_is_error(self):
+        mon = mkcluster(n=3, pools=[("p1", 8, 2, 1)])
+        mon.osd_down(1)
+        mon.osd_down(2)
+        h = Manager(mon).health()
+        assert h["status"] == "HEALTH_ERR"
+        assert "PG_UNAVAILABLE" in h["checks"]
+
+    def test_autoscaler_feeds_health(self):
+        mon = mkcluster(n=6, pools=[("p1", 1, 2, 1)])
+        h = Manager(mon).health()
+        assert "POOL_PG_NUM" in h["checks"]

@@ -237,3 +237,33 @@ class TestPipelineIntegration:
         assert reads.read_sync("obj", 0, len(data)) == data
         assert reads.perf.get("retries") == 1
         ec_inject.clear_all()
+
+
+class TestDebugModes:
+    """debug_* options map onto jax debug flags (the sanitizer-toggle
+    analog, SURVEY §5.2) and flip live via the admin socket."""
+
+    def test_admin_config_set_flips_jax_flag(self):
+        import jax
+
+        from ceph_tpu.utils.admin_socket import admin_socket
+        from ceph_tpu.utils.config import config
+
+        assert not jax.config.jax_debug_nans
+        try:
+            admin_socket.execute(
+                "config set", name="debug_nan_check", value="true"
+            )
+            assert jax.config.jax_debug_nans
+        finally:
+            admin_socket.execute(
+                "config set", name="debug_nan_check", value="false"
+            )
+        assert not jax.config.jax_debug_nans
+        assert not config.get("debug_nan_check")
+
+    def test_apply_is_idempotent(self):
+        from ceph_tpu.utils import apply_debug_modes
+
+        apply_debug_modes()
+        apply_debug_modes()
