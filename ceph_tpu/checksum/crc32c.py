@@ -187,6 +187,18 @@ def crc32c_device(
     block_bytes = int(data.shape[-1])
     lead = data.shape[:-1]
     flat = data.reshape(-1, block_bytes)
+    from ceph_tpu.utils import config
+
+    from . import pallas_crc
+
+    if (
+        config.get("ec_use_pallas")
+        and pallas_crc.supported(int(flat.shape[0]), block_bytes)
+    ):
+        from ceph_tpu.ops.pallas_encode import on_tpu
+
+        if on_tpu():
+            return pallas_crc.crc32c_fold_pallas(flat, init).reshape(lead)
     c = _pick_chunk(block_bytes)
     k_fold, a_total = _device_fold(block_bytes, c)
     out = _crc32c_kernel(

@@ -35,17 +35,9 @@ from ceph_tpu.store import BlockStore, FileStore
 
 
 def _open_store(osd_dir: str):
-    """Open an existing OSD dir with the backend it was created with
-    (the ``backend`` marker; device-file detection as fallback)."""
-    marker = os.path.join(osd_dir, "backend")
-    if os.path.exists(marker):
-        kind = open(marker).read().strip()
-    else:
-        kind = (
-            "block" if os.path.exists(os.path.join(osd_dir, "block"))
-            else "file"
-        )
-    return BlockStore(osd_dir) if kind == "block" else FileStore(osd_dir)
+    from ceph_tpu.store import open_store
+
+    return open_store(osd_dir)
 
 
 def _cluster_backend(root: str) -> str | None:

@@ -45,6 +45,7 @@ class Manager:
         balance_threshold: float = 0.10,
         balance_step: float = 0.15,
         min_weight: float = 0.1,
+        max_weight: float = 8.0,
     ) -> None:
         self.monitor = monitor
         #: deadband: |pgs - mean| / mean below this is "balanced"
@@ -53,6 +54,11 @@ class Manager:
         #: without thrashing data movement)
         self.balance_step = balance_step
         self.min_weight = min_weight
+        #: ceiling: a structurally under-full OSD (e.g. the lone member
+        #: of its failure domain) can never be fixed by weight — an
+        #: unbounded raise would grow geometrically under tick() and
+        #: churn a reweight epoch + backfill every pass
+        self.max_weight = max_weight
         self._lock = threading.Lock()
         self.last_health: dict = {"status": "HEALTH_OK", "checks": {}}
 
@@ -100,7 +106,10 @@ class Manager:
                 1.0 - self.balance_step,
                 min(1.0 + self.balance_step, mean / max(pgs, 1)),
             )
-            new = max(self.min_weight, round(cur * factor, 4))
+            new = min(
+                self.max_weight,
+                max(self.min_weight, round(cur * factor, 4)),
+            )
             if new != cur:
                 changed[osd] = new
         for osd, w in changed.items():

@@ -40,19 +40,9 @@ from ceph_tpu.store import framed_log
 
 
 def open_store(data_path: str):
-    """Open the store with the backend the directory was created with
-    (the ``backend`` marker the CLI writes; device-file fallback)."""
-    from ceph_tpu.store import BlockStore, FileStore
+    from ceph_tpu.store import open_store as _open
 
-    marker = os.path.join(data_path, "backend")
-    if os.path.exists(marker):
-        kind = open(marker).read().strip()
-    else:
-        kind = (
-            "block" if os.path.exists(os.path.join(data_path, "block"))
-            else "file"
-        )
-    return BlockStore(data_path) if kind == "block" else FileStore(data_path)
+    return _open(data_path)
 
 
 def _obj_row(store, oid: str) -> dict:
@@ -153,14 +143,27 @@ def op_import(store, args) -> int:
             f"archive corrupt past byte {valid_end}; importing the "
             "valid prefix only", file=sys.stderr,
         )
+    # Pre-pass conflict check so the import is all-or-nothing: a
+    # mid-archive abort after earlier records applied would leave the
+    # store half-restored while reporting failure.
+    if not args.force:
+        clashes = []
+        for payload in records:
+            hdr_raw, _, _data = payload.partition(b"\0")
+            oid = json.loads(hdr_raw.decode())["oid"]
+            if store.exists(oid):
+                clashes.append(oid)
+        if clashes:
+            for oid in clashes:
+                print(
+                    f"{oid}: exists (--force overwrites)", file=sys.stderr
+                )
+            return 1
     n = 0
     for payload in records:
         hdr_raw, _, data = payload.partition(b"\0")
         hdr = json.loads(hdr_raw.decode())
         oid = hdr["oid"]
-        if store.exists(oid) and not args.force:
-            print(f"{oid}: exists (--force overwrites)", file=sys.stderr)
-            return 1
         txn = Transaction().touch(oid)
         if store.exists(oid):
             txn.remove(oid).touch(oid)
@@ -183,10 +186,12 @@ def op_remove(store, args) -> int:
     if not args.objects:
         print("remove needs object names", file=sys.stderr)
         return 2
-    for oid in args.objects:
-        if not store.exists(oid):
+    missing = [oid for oid in args.objects if not store.exists(oid)]
+    if missing:  # all-or-nothing: fail before touching anything
+        for oid in missing:
             print(f"{oid}: not found", file=sys.stderr)
-            return 1
+        return 1
+    for oid in args.objects:
         store.queue_transactions(Transaction().remove(oid))
         print(f"removed {oid}")
     return 0
@@ -196,7 +201,8 @@ def op_fsck(store, args) -> int:
     """Read every object fully (BlockStore verifies per-blob CRCs on
     read — the BlueStore fsck deep mode) and parse identity attrs."""
     bad = 0
-    for oid in store.list_objects():
+    oids = store.list_objects()
+    for oid in oids:
         try:
             store.read(oid)
         except Exception as e:
@@ -212,8 +218,7 @@ def op_fsck(store, args) -> int:
         except ValueError as e:
             print(f"{oid}: corrupt OI attr: {e}")
             bad += 1
-    total = len(store.list_objects())
-    print(f"fsck: {total} objects, {bad} errors")
+    print(f"fsck: {len(oids)} objects, {bad} errors")
     return 0 if bad == 0 else 1
 
 
