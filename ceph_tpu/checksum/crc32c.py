@@ -127,13 +127,9 @@ def _device_fold(block_bytes: int, c: int):
     shard_map) the arrays become tracers, which must NOT be cached —
     they are embedded as compile-time constants instead."""
     kf, at = _host_fold(block_bytes, c)
-    try:
-        import jax.core
+    from ceph_tpu.utils.platform import trace_state_clean
 
-        tracing = not jax.core.trace_state_clean()
-    except Exception:
-        tracing = True  # be safe: never cache inside unknown state
-    if tracing:
+    if not trace_state_clean():
         return jnp.asarray(kf, jnp.int8), jnp.asarray(at, jnp.int8)
     key = (block_bytes, c)
     if key not in _device_cache:
@@ -142,6 +138,38 @@ def _device_fold(block_bytes: int, c: int):
             jnp.asarray(at, jnp.int8),
         )
     return _device_cache[key]
+
+
+def fold_blocks_bits(k_fold: jax.Array, data: jax.Array) -> jax.Array:
+    """[B, L] uint8 x [S, 32, c*8] fold tensor -> [B, 32] int32
+    remainder counts (mod 2 pending) — the shared einsum fold body."""
+    c8 = k_fold.shape[-1]
+    s = k_fold.shape[0]
+    chunks = data.reshape(data.shape[0], s, c8 // 8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((chunks[..., None] >> shifts) & jnp.uint8(1)).reshape(
+        data.shape[0], s, c8
+    )
+    return jnp.einsum(
+        "src,bsc->br",
+        k_fold,
+        bits.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def init_bits32(init) -> jax.Array:
+    return (
+        (jnp.asarray(init, jnp.uint32) >> jnp.arange(32, dtype=jnp.uint32))
+        & 1
+    ).astype(jnp.int8)
+
+
+def acc_to_crc32(acc: jax.Array) -> jax.Array:
+    """[..., 32] int32 counts -> [...] uint32 (mod 2 + bit pack)."""
+    crc_bits = (acc & 1).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(crc_bits * weights, axis=-1, dtype=jnp.uint32)
 
 
 @functools.partial(jax.jit, static_argnames=("block_bytes",))
@@ -153,26 +181,11 @@ def _crc32c_kernel(
     *,
     block_bytes: int,
 ) -> jax.Array:
-    c8 = k_fold.shape[-1]
-    s = k_fold.shape[0]
-    chunks = data.reshape(data.shape[0], s, c8 // 8)
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = ((chunks[..., None] >> shifts) & jnp.uint8(1)).reshape(
-        data.shape[0], s, c8
+    acc = fold_blocks_bits(k_fold, data)
+    acc = acc + (
+        a_total.astype(jnp.int32) @ init_bits32(init).astype(jnp.int32)
     )
-    acc = jnp.einsum(
-        "src,bsc->br",
-        k_fold,
-        bits.astype(jnp.int8),
-        preferred_element_type=jnp.int32,
-    )
-    init_bits = ((init >> jnp.arange(32, dtype=jnp.uint32)) & 1).astype(
-        jnp.int8
-    )
-    acc = acc + (a_total.astype(jnp.int32) @ init_bits.astype(jnp.int32))
-    crc_bits = (acc & 1).astype(jnp.uint32)
-    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
-    return jnp.sum(crc_bits * weights, axis=-1, dtype=jnp.uint32)
+    return acc_to_crc32(acc)
 
 
 def crc32c_device(

@@ -1,5 +1,9 @@
 """Multi-chip shard fan-out over a jax.sharding.Mesh."""
 
+from .collectives import (  # noqa: F401
+    ring_parity,
+    sharded_crc32c,
+)
 from .mesh import (  # noqa: F401
     make_ec_mesh,
     sharded_decode,

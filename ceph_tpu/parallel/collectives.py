@@ -1,0 +1,225 @@
+"""Alternative collective schedules for the EC fan-out: ring parity
+accumulation and sequence-parallel CRC.
+
+Two distributed patterns beyond mesh.py's all-reduce encode, mirroring
+the scaling-book playbook (pick a mesh, annotate shardings, let XLA
+place collectives on ICI):
+
+**Ring parity** (`ring_parity`): the XOR-reduction across the shard
+axis as an explicit ring of ``lax.ppermute`` steps — the ring-allreduce
+schedule (and the ring-attention communication shape: a rotating
+accumulator passes around the ring while every device folds in its
+local partial). The accumulator travels PACKED ([b, m, N] uint8 —
+XOR commutes with bit packing), so each hop moves exactly the parity
+bytes. Bit-exact with ``sharded_encode``'s psum; the explicit schedule
+is the form to reach for when the shard axis spans links where psum's
+tree placement is suboptimal.
+
+**Sequence-parallel CRC32C** (`sharded_crc32c`): the long-object axis
+(SURVEY.md §5.7 — object size is this framework's sequence length)
+sharded across devices. CRC is position-dependent, so naive sharding
+breaks; linearity saves it: with per-device fold tensors pre-composed
+with the zero-gap transition for the device's suffix length
+(crc32c.zero_gap_matrix), each device folds its local bytes and the
+combine is a single 32-bit-per-block XOR-allreduce:
+
+    crc(block) = mod2( Σ_d  A_{suffix(d)} @ fold(bytes_d) )
+
+One object of any length (left-padded with zero bytes to the mesh
+granularity — a no-op for the fold, since zeros from the zero register
+stay zero, while the init contribution uses the true length) hashes
+with one psum of [B, 32] ints — the deep-scrub integrity pass for
+objects too large for one chip's HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ceph_tpu.ops.bitplane import pack_bits
+
+from .mesh import partial_parity_counts
+
+#: fixed fold granularity for the sequence-parallel CRC scan: keeps
+#: the fold-tensor constant bounded (<= 16 MiB) no matter how long
+#: the object is — a monolithic per-segment tensor would be 256x the
+#: segment size and OOM exactly on the large objects this op exists for
+FOLD_BLOCK_MAX = 65536
+
+
+def ring_parity(
+    mesh: Mesh, bitmatrix: jax.Array, data: jax.Array
+) -> jax.Array:
+    """[B, k, N] uint8 -> [B, m, N] parity; XOR-reduction over the
+    ``sp`` axis scheduled as an explicit ring instead of psum."""
+    sp = mesh.shape["sp"]
+
+    def local(bmat_cols: jax.Array, shards: jax.Array) -> jax.Array:
+        acc = partial_parity_counts(bmat_cols, shards)
+        # pack BEFORE the ring: per-hop traffic is the parity bytes,
+        # not the 8x bit expansion
+        partial = pack_bits((acc & 1).astype(jnp.uint8))  # [b, m, N]
+
+        def hop(_i, carry):
+            moved = jax.lax.ppermute(
+                carry, "sp",
+                [(d, (d + 1) % sp) for d in range(sp)],
+            )
+            return jnp.bitwise_xor(moved, partial)
+
+        # after sp-1 hops every device's accumulator has folded every
+        # partial exactly once: a ring all-reduce in GF(2)
+        return jax.lax.fori_loop(0, sp - 1, hop, partial)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P("dp", "sp", None)),
+        out_specs=P("dp", None, None),
+        check_vma=False,
+    )
+    return fn(bitmatrix, data)
+
+
+def _suffix_transforms(n_shards: int, local_bytes: int) -> np.ndarray:
+    """[D, 32, 32] with row d = A_{(D-1-d)*local}: the zero-gap
+    transition carrying device d's local remainder across everything
+    to its right."""
+    from ceph_tpu.checksum.crc32c import zero_gap_matrix
+
+    out = np.empty((n_shards, 32, 32), dtype=np.int8)
+    for d in range(n_shards):
+        out[d] = np.frombuffer(
+            zero_gap_matrix((n_shards - 1 - d) * local_bytes),
+            dtype=np.uint8,
+        ).reshape(32, 32)
+    return out
+
+
+_const_cache: dict = {}
+
+
+def _pick_fold_block(local_bytes: int) -> int:
+    """Largest divisor of the local segment <= FOLD_BLOCK_MAX that is
+    a multiple of 64 (the chunk-fold granularity)."""
+    best = 64
+    d = 64
+    while d <= min(FOLD_BLOCK_MAX, local_bytes):
+        if local_bytes % d == 0:
+            best = d
+        d += 64
+    return best
+
+
+def _sharded_crc_consts(padded: int, n_dev: int):
+    """Device-resident (K_fb, A_fb, suffix stack) for the scan fold —
+    cached per (padded, n_dev) geometry unless under a trace (the
+    _device_fold discipline: tracer leaks poison caches; re-upload
+    through the tunnel is 10x). The true-length init transform is NOT
+    here: it varies per object length and is a tiny 32x32."""
+    from ceph_tpu.checksum.crc32c import (
+        _pick_chunk,
+        fold_tensor,
+        zero_gap_matrix,
+    )
+
+    local_bytes = padded // n_dev
+    fb = _pick_fold_block(local_bytes)
+    c = _pick_chunk(fb)
+
+    def build():
+        return (
+            jnp.asarray(fold_tensor(fb, c), jnp.int8),
+            jnp.asarray(
+                np.frombuffer(
+                    zero_gap_matrix(fb), dtype=np.uint8
+                ).reshape(32, 32),
+                jnp.int32,
+            ),
+            jnp.asarray(_suffix_transforms(n_dev, local_bytes)),
+        )
+
+    from ceph_tpu.utils.platform import trace_state_clean
+
+    if not trace_state_clean():
+        return build()
+    key = (padded, n_dev)
+    if key not in _const_cache:
+        _const_cache[key] = build()
+    return _const_cache[key]
+
+
+def sharded_crc32c(
+    mesh: Mesh,
+    data: jax.Array,  # [B, L] uint8, L sharded over ``axes``
+    init: int = 0xFFFFFFFF,
+    axes: tuple[str, ...] = ("dp", "sp"),
+) -> jax.Array:
+    """Per-block CRC32C with the BLOCK axis sharded across the WHOLE
+    mesh (both axes by default — this op has no stripe axis to give
+    ``dp``, so anything less duplicates data and FLOPs). Each device
+    scans its segment in FOLD_BLOCK-bounded pieces
+
+        r <- (r @ A_fb^T) xor fold(piece)      (remainder chaining)
+
+    so the fold-tensor constant stays <= 16 MiB for any object length.
+    Returns [B] uint32."""
+    from ceph_tpu.checksum.crc32c import (
+        acc_to_crc32,
+        fold_blocks_bits,
+        init_bits32,
+        zero_gap_matrix,
+    )
+
+    nblocks, total = data.shape
+    n_dev = 1
+    for a in axes:
+        n_dev *= mesh.shape[a]
+    # Left-pad with zero bytes to the mesh granularity: a no-op for
+    # the zero-init fold; the init contribution below uses TRUE length.
+    pad = (-total) % (n_dev * 64)  # 64 keeps the chunk fold aligned
+    if pad:
+        data = jnp.pad(data, ((0, 0), (pad, 0)))
+    k_fb, a_fb, suffix = _sharded_crc_consts(total + pad, n_dev)
+    fb = k_fb.shape[0] * (k_fb.shape[2] // 8)
+    local_bytes = (total + pad) // n_dev
+    npieces = local_bytes // fb
+
+    def local(kf, afb, sfx, blocks):
+        pieces = blocks.reshape(blocks.shape[0], npieces, fb)
+
+        def step(r, piece):
+            folded = fold_blocks_bits(kf, piece) & 1
+            r = ((r @ afb.T) + folded) & 1
+            return r, None
+
+        r0 = jnp.zeros((blocks.shape[0], 32), jnp.int32)
+        local_bits, _ = jax.lax.scan(
+            step, r0, jnp.swapaxes(pieces, 0, 1)
+        )
+        d = jax.lax.axis_index(axes)
+        a_sfx = jax.lax.dynamic_index_in_dim(
+            sfx, d, axis=0, keepdims=False
+        ).astype(jnp.int32)
+        carried = local_bits @ a_sfx.T  # [B, 32] suffix-shifted
+        return jax.lax.psum(carried, axes)  # one 32-int all-reduce
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, axes)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    acc = fn(k_fb, a_fb, suffix, data)
+    a_true = jnp.asarray(
+        np.frombuffer(
+            zero_gap_matrix(total), dtype=np.uint8
+        ).reshape(32, 32),
+        jnp.int32,
+    )
+    acc = acc + (a_true @ init_bits32(init).astype(jnp.int32))
+    return acc_to_crc32(acc)

@@ -51,6 +51,21 @@ def make_ec_mesh(n_devices: int | None = None, k: int = 8) -> Mesh:
     return Mesh(np.array(devs).reshape(dp, sp), ("dp", "sp"))
 
 
+def partial_parity_counts(
+    bmat_cols: jax.Array, shards: jax.Array
+) -> jax.Array:
+    """One device's contribution to the parity bit counts:
+    [m*8, k_local*8] x [b, k_local, N] -> [b, m*8, N] int32 (mod 2
+    pending). The shared local body of every parity collective."""
+    bits = unpack_bits(shards)
+    return jnp.einsum(
+        "rc,bcn->brn",
+        bmat_cols.astype(jnp.int8),
+        bits.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
+
+
 def sharded_encode(
     mesh: Mesh, bitmatrix: jax.Array, data: jax.Array
 ) -> jax.Array:
@@ -61,14 +76,7 @@ def sharded_encode(
     blocks are sharded over ``sp`` alongside the data shards.
     """
     def local(bmat_cols: jax.Array, shards: jax.Array) -> jax.Array:
-        # shards: [b_local, k_local, N]; bmat_cols: [m*8, k_local*8]
-        bits = unpack_bits(shards)
-        acc = jnp.einsum(
-            "rc,bcn->brn",
-            bmat_cols.astype(jnp.int8),
-            bits.astype(jnp.int8),
-            preferred_element_type=jnp.int32,
-        )
+        acc = partial_parity_counts(bmat_cols, shards)
         acc = jax.lax.psum(acc, "sp")  # XOR-allreduce (mod 2 below)
         return pack_bits((acc & 1).astype(jnp.uint8))
 

@@ -13,6 +13,24 @@ from __future__ import annotations
 import os
 
 
+def trace_state_clean() -> bool:
+    """True when no jax trace is active — the guard for caching device
+    arrays (a tracer cached from inside jit poisons every later call).
+    jax 0.9 moved trace_state_clean out of the public jax.core; try
+    both homes and fail CLOSED (treat unknown as tracing)."""
+    for modname in ("jax.core", "jax._src.core"):
+        try:
+            import importlib
+
+            mod = importlib.import_module(modname)
+            fn = getattr(mod, "trace_state_clean", None)
+            if fn is not None:
+                return bool(fn())
+        except Exception:
+            continue
+    return False
+
+
 def apply_debug_modes() -> None:
     """Map the debug_* config options onto JAX debug flags — the
     runtime analog of the reference's WITH_ASAN/WITH_TSAN compile-time
