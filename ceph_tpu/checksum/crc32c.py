@@ -58,6 +58,11 @@ def _mat(b: bytes) -> np.ndarray:
     return np.frombuffer(b, dtype=np.uint8).reshape(32, 32)
 
 
+#: public alias: consumers decoding zero_gap_matrix/byte_step_matrix
+#: payloads must share ONE layout definition
+mat32 = _mat
+
+
 @functools.lru_cache(maxsize=None)
 def zero_gap_matrix(nbytes: int) -> bytes:
     """A_n = M^n: transition across n zero bytes (square-and-multiply)."""

@@ -61,6 +61,12 @@ class Cluster:
 
     def __init__(self, root: str, quiet: bool = True) -> None:
         self.root = root
+        # keyring (cluster PSK): presence turns on AES-GCM secure mode
+        # for every daemon and client link of this cluster
+        keyring = os.path.join(root, "keyring")
+        self.secret: bytes | None = None
+        if os.path.exists(keyring):
+            self.secret = open(keyring, "rb").read().strip() or None
         self.mon_store = MonStore(os.path.join(root, "mon", "store.log"))
         initial, history = self.mon_store.replay()
         self.mon = Monitor(
@@ -75,13 +81,13 @@ class Cluster:
             if os.path.exists(os.path.join(root, name, "stopped")):
                 continue  # operator stopped it (osd-down marker)
             store = _open_store(os.path.join(root, name))
-            d = OSDDaemon(osd, self.mon, store=store)
+            d = OSDDaemon(osd, self.mon, store=store, secret=self.secret)
             d.start()
             self.daemons[osd] = d
         # anything in the map but not on disk is gone: mark it down
         for osd in sorted(self.mon.osdmap.up_osds() - set(self.daemons)):
             self.mon.osd_down(osd)
-        self.client = RadosClient(self.mon, backoff=0.02)
+        self.client = RadosClient(self.mon, backoff=0.02, secret=self.secret)
 
     def add_osd(self, osd: int, zone: str = "", backend: str | None = None) -> None:
         self.mon.osd_crush_add(osd, zone=zone)
@@ -90,7 +96,7 @@ class Cluster:
         store = BlockStore(path) if backend == "block" else FileStore(path)
         with open(os.path.join(path, "backend"), "w") as f:
             f.write(backend)
-        d = OSDDaemon(osd, self.mon, store=store)
+        d = OSDDaemon(osd, self.mon, store=store, secret=self.secret)
         d.start()
         self.daemons[osd] = d
 
@@ -110,6 +116,17 @@ class Cluster:
 
 
 def cmd_vstart(cl: Cluster, args) -> int:
+    if getattr(args, "secure", False) and cl.secret is None:
+        # generate the keyring; takes effect from the NEXT invocation
+        # (this one already booted plaintext)
+        import secrets as _secrets
+
+        # hex, not raw bytes: the file is read with a whitespace
+        # strip, which must never change the effective key
+        with open(os.path.join(cl.root, "keyring"), "w") as f:
+            f.write(_secrets.token_hex(32) + "\n")
+        print("keyring written: cluster runs AES-GCM secure mode from "
+              "the next invocation")
     existing = set(cl.daemons)
     for i in range(args.osds):
         if i not in existing:
@@ -424,6 +441,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="OSD backend for NEW osds: FileStore tree or BlockStore "
              "raw device (default: whatever the cluster already uses, "
              "else file)",
+    )
+    s.add_argument(
+        "--secure", action="store_true",
+        help="generate a cluster keyring (AES-GCM secure mode for all "
+             "links from the next invocation on)",
     )
     s.add_argument(
         "--exporter", type=int, nargs="?", const=0, default=None,

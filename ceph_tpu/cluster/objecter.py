@@ -46,12 +46,14 @@ class Objecter:
         max_attempts: int = 8,
         op_timeout: float = 30.0,
         backoff: float = 0.05,
+        secret: bytes | None = None,
     ) -> None:
         self.monitor = monitor
         self.max_attempts = max_attempts
         self.op_timeout = op_timeout
         self.backoff = backoff
-        self.messenger = Messenger("client")
+        # cluster PSK (keyring role): all client connections sealed
+        self.messenger = Messenger("client", secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
         self._conns: dict[tuple[str, int], Connection] = {}
         self._tids = itertools.count(1)

@@ -288,6 +288,7 @@ class OSDDaemon:
         op_timeout: float = 15.0,
         tick_period: float = 2.0,
         scheduler_profiles=None,
+        secret: bytes | None = None,
     ) -> None:
         from ceph_tpu.utils.log import get_logger
 
@@ -298,9 +299,9 @@ class OSDDaemon:
         self.chunk_size = chunk_size
         self.op_timeout = op_timeout
         self.local = ShardBackend(_AnyShardStores(self.store))
-        self.peers = NetShardBackend({})
+        self.peers = NetShardBackend({}, secret=secret)
         self.osdmap: OSDMap = monitor.osdmap
-        self.messenger = Messenger(f"osd.{osd_id}")
+        self.messenger = Messenger(f"osd.{osd_id}", secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
         self.addr: tuple[str, int] | None = None
         self._pgs: dict[tuple[str, int], _PG] = {}

@@ -6,14 +6,14 @@ the scaling-book playbook (pick a mesh, annotate shardings, let XLA
 place collectives on ICI):
 
 **Ring parity** (`ring_parity`): the XOR-reduction across the shard
-axis as an explicit ring of ``lax.ppermute`` steps — the ring-allreduce
-schedule (and the ring-attention communication shape: a rotating
-accumulator passes around the ring while every device folds in its
-local partial). The accumulator travels PACKED ([b, m, N] uint8 —
-XOR commutes with bit packing), so each hop moves exactly the parity
-bytes. Bit-exact with ``sharded_encode``'s psum; the explicit schedule
-is the form to reach for when the shard axis spans links where psum's
-tree placement is suboptimal.
+axis as the canonical bandwidth-optimal ring all-reduce — a
+reduce-scatter phase (each of sp-1 hops moves ONE 1/sp slice of the
+packed parity; after them device d owns the fully-reduced slice) then
+an all-gather phase (sp-1 more one-slice hops) — ~2(sp-1)/sp times
+the parity bytes per link, the schedule large-model training uses
+over ICI. The accumulator travels PACKED (XOR commutes with bit
+packing). Bit-exact with ``sharded_encode``'s psum; falls back to
+psum when the lane axis doesn't split into sp slices.
 
 **Sequence-parallel CRC32C** (`sharded_crc32c`): the long-object axis
 (SURVEY.md §5.7 — object size is this framework's sequence length)
@@ -54,25 +54,66 @@ def ring_parity(
     mesh: Mesh, bitmatrix: jax.Array, data: jax.Array
 ) -> jax.Array:
     """[B, k, N] uint8 -> [B, m, N] parity; XOR-reduction over the
-    ``sp`` axis scheduled as an explicit ring instead of psum."""
+    ``sp`` axis as ring reduce-scatter + all-gather."""
     sp = mesh.shape["sp"]
+    n = data.shape[-1]
+    if sp == 1 or n % sp:
+        # no ring to run / lane axis unsliceable: psum is the schedule
+        from .mesh import sharded_encode
+
+        return sharded_encode(mesh, bitmatrix, data)
+    w = n // sp
+    fwd = [(d, (d + 1) % sp) for d in range(sp)]
 
     def local(bmat_cols: jax.Array, shards: jax.Array) -> jax.Array:
         acc = partial_parity_counts(bmat_cols, shards)
-        # pack BEFORE the ring: per-hop traffic is the parity bytes,
-        # not the 8x bit expansion
-        partial = pack_bits((acc & 1).astype(jnp.uint8))  # [b, m, N]
+        # pack BEFORE the ring: hop traffic is parity bytes, not the
+        # 8x bit expansion
+        partial = pack_bits((acc & 1).astype(jnp.uint8))  # [b, m, n]
+        d = jax.lax.axis_index("sp")
 
-        def hop(_i, carry):
-            moved = jax.lax.ppermute(
-                carry, "sp",
-                [(d, (d + 1) % sp) for d in range(sp)],
+        def slice_at(x, j):
+            return jax.lax.dynamic_slice_in_dim(x, j * w, w, axis=-1)
+
+        # -- reduce-scatter: at step t device d sends its accumulated
+        # slice (d - t) mod sp and folds its own contribution into the
+        # slice arriving from d-1. After sp-1 steps it owns the FULLY
+        # reduced slice (d + 1) mod sp.
+        def rs_step(t, carry):
+            send = jax.lax.cond(
+                t == 0,
+                lambda: slice_at(partial, (d - t) % sp),
+                lambda: carry,
             )
-            return jnp.bitwise_xor(moved, partial)
+            recv = jax.lax.ppermute(send, "sp", fwd)
+            return jnp.bitwise_xor(
+                recv, slice_at(partial, (d - t - 1) % sp)
+            )
 
-        # after sp-1 hops every device's accumulator has folded every
-        # partial exactly once: a ring all-reduce in GF(2)
-        return jax.lax.fori_loop(0, sp - 1, hop, partial)
+        mine = jax.lax.fori_loop(
+            0, sp - 1, rs_step,
+            jnp.zeros(partial.shape[:-1] + (w,), jnp.uint8),
+        )
+        my_slice = (d + 1) % sp
+
+        # -- all-gather: circulate the reduced slices; each device
+        # scatters every arriving slice into its output at the slice
+        # index it belongs to ((d + 1 - t) mod sp at step t).
+        out = jax.lax.dynamic_update_slice_in_dim(
+            jnp.zeros_like(partial), mine, my_slice * w, axis=-1
+        )
+
+        def ag_step(t, carry):
+            out, moving = carry
+            moving = jax.lax.ppermute(moving, "sp", fwd)
+            src = (d - t) % sp  # slice index the arrival carries
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, moving, src * w, axis=-1
+            )
+            return out, moving
+
+        out, _ = jax.lax.fori_loop(0, sp - 1, ag_step, (out, mine))
+        return out
 
     fn = jax.shard_map(
         local,
@@ -88,68 +129,66 @@ def _suffix_transforms(n_shards: int, local_bytes: int) -> np.ndarray:
     """[D, 32, 32] with row d = A_{(D-1-d)*local}: the zero-gap
     transition carrying device d's local remainder across everything
     to its right."""
-    from ceph_tpu.checksum.crc32c import zero_gap_matrix
+    from ceph_tpu.checksum.crc32c import mat32, zero_gap_matrix
 
     out = np.empty((n_shards, 32, 32), dtype=np.int8)
     for d in range(n_shards):
-        out[d] = np.frombuffer(
-            zero_gap_matrix((n_shards - 1 - d) * local_bytes),
-            dtype=np.uint8,
-        ).reshape(32, 32)
+        out[d] = mat32(zero_gap_matrix((n_shards - 1 - d) * local_bytes))
     return out
 
 
-_const_cache: dict = {}
+_fold_cache: dict = {}
+_suffix_cache: dict = {}
 
 
-def _pick_fold_block(local_bytes: int) -> int:
-    """Largest divisor of the local segment <= FOLD_BLOCK_MAX that is
-    a multiple of 64 (the chunk-fold granularity)."""
-    best = 64
-    d = 64
-    while d <= min(FOLD_BLOCK_MAX, local_bytes):
-        if local_bytes % d == 0:
-            best = d
-        d += 64
-    return best
+def _pick_geometry(total: int, n_dev: int) -> tuple[int, int, int]:
+    """(fb, npieces, padded): fold-block chosen FIRST (padding with
+    zeros is free), so awkward lengths never degenerate into tiny
+    folds — the object pads up to n_dev * npieces * fb."""
+    local = -(-total // n_dev)
+    fb = min(FOLD_BLOCK_MAX, ((local + 63) // 64) * 64)
+    npieces = -(-local // fb)
+    return fb, npieces, n_dev * npieces * fb
 
 
-def _sharded_crc_consts(padded: int, n_dev: int):
-    """Device-resident (K_fb, A_fb, suffix stack) for the scan fold —
-    cached per (padded, n_dev) geometry unless under a trace (the
-    _device_fold discipline: tracer leaks poison caches; re-upload
-    through the tunnel is 10x). The true-length init transform is NOT
-    here: it varies per object length and is a tiny 32x32."""
+def _fold_consts(fb: int):
+    """(K_fb, A_fb), cached per fold-block size ONLY — the big tensor
+    (fb*256 bytes) has a handful of distinct sizes, never one per
+    object length. Trace guard per the _device_fold discipline."""
     from ceph_tpu.checksum.crc32c import (
         _pick_chunk,
         fold_tensor,
+        mat32,
         zero_gap_matrix,
     )
-
-    local_bytes = padded // n_dev
-    fb = _pick_fold_block(local_bytes)
-    c = _pick_chunk(fb)
+    from ceph_tpu.utils.platform import trace_state_clean
 
     def build():
         return (
-            jnp.asarray(fold_tensor(fb, c), jnp.int8),
-            jnp.asarray(
-                np.frombuffer(
-                    zero_gap_matrix(fb), dtype=np.uint8
-                ).reshape(32, 32),
-                jnp.int32,
-            ),
-            jnp.asarray(_suffix_transforms(n_dev, local_bytes)),
+            jnp.asarray(fold_tensor(fb, _pick_chunk(fb)), jnp.int8),
+            jnp.asarray(mat32(zero_gap_matrix(fb)), jnp.int32),
         )
-
-    from ceph_tpu.utils.platform import trace_state_clean
 
     if not trace_state_clean():
         return build()
-    key = (padded, n_dev)
-    if key not in _const_cache:
-        _const_cache[key] = build()
-    return _const_cache[key]
+    if fb not in _fold_cache:
+        _fold_cache[fb] = build()
+    return _fold_cache[fb]
+
+
+def _suffix_consts(n_dev: int, local_bytes: int):
+    """Suffix transform stack — [D, 32, 32] int8, tiny; cached per
+    geometry."""
+    from ceph_tpu.utils.platform import trace_state_clean
+
+    if not trace_state_clean():
+        return jnp.asarray(_suffix_transforms(n_dev, local_bytes))
+    key = (n_dev, local_bytes)
+    if key not in _suffix_cache:
+        _suffix_cache[key] = jnp.asarray(
+            _suffix_transforms(n_dev, local_bytes)
+        )
+    return _suffix_cache[key]
 
 
 def sharded_crc32c(
@@ -178,15 +217,14 @@ def sharded_crc32c(
     n_dev = 1
     for a in axes:
         n_dev *= mesh.shape[a]
-    # Left-pad with zero bytes to the mesh granularity: a no-op for
-    # the zero-init fold; the init contribution below uses TRUE length.
-    pad = (-total) % (n_dev * 64)  # 64 keeps the chunk fold aligned
-    if pad:
-        data = jnp.pad(data, ((0, 0), (pad, 0)))
-    k_fb, a_fb, suffix = _sharded_crc_consts(total + pad, n_dev)
-    fb = k_fb.shape[0] * (k_fb.shape[2] // 8)
-    local_bytes = (total + pad) // n_dev
-    npieces = local_bytes // fb
+    fb, npieces, padded = _pick_geometry(total, n_dev)
+    # Left-pad with zero bytes to the fold geometry: a no-op for the
+    # zero-init fold; the init contribution below uses TRUE length.
+    if padded != total:
+        data = jnp.pad(data, ((0, 0), (padded - total, 0)))
+    k_fb, a_fb = _fold_consts(fb)
+    local_bytes = padded // n_dev
+    suffix = _suffix_consts(n_dev, local_bytes)
 
     def local(kf, afb, sfx, blocks):
         pieces = blocks.reshape(blocks.shape[0], npieces, fb)

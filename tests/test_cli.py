@@ -181,3 +181,19 @@ def test_cli_mgr_commands(cdir, tmp_path, capsys):
     assert rc == 1
     assert "HEALTH_WARN" in out or "HEALTH_ERR" in out
     assert "OSD_DOWN" in out
+
+
+def test_cli_secure_cluster(cdir, tmp_path, capsys):
+    """vstart --secure writes a keyring; subsequent invocations run
+    every link sealed and still serve IO across cluster reboots."""
+    out = run(capsys, "-d", cdir, "vstart", "--osds", "4", "--secure")
+    assert "keyring written" in out
+    run(capsys, "-d", cdir, "profile-set", "rs21",
+        "plugin=isa", "k=2", "m=1")
+    run(capsys, "-d", cdir, "pool-create", "p", "8", "rs21")
+    blob = tmp_path / "blob"
+    blob.write_bytes(b"sealed-bytes" * 100)
+    run(capsys, "-d", cdir, "put", "p", "obj", str(blob))
+    out_file = tmp_path / "out"
+    run(capsys, "-d", cdir, "get", "p", "obj", str(out_file))
+    assert out_file.read_bytes() == blob.read_bytes()
