@@ -39,6 +39,8 @@ from ceph_tpu.msg.messages import (
     ECSubReadReply,
     ECSubWrite,
     ECSubWriteReply,
+    GetAttrs,
+    GetAttrsReply,
     OSDOp,
     OSDOpReply,
     PGList,
@@ -857,6 +859,10 @@ class OSDDaemon:
             )
         elif isinstance(msg, ECSubRead):
             self._handle_sub_read(conn, msg)
+        elif isinstance(msg, GetAttrs):
+            from ceph_tpu.msg.messages import serve_get_attrs
+
+            serve_get_attrs(self.store, self.osd_id, conn, msg)
         elif isinstance(msg, PGList):
             self._handle_pg_list(conn, msg)
         elif isinstance(msg, OSDOp):
@@ -1462,17 +1468,18 @@ class OSDDaemon:
             # removed between enumeration and this lock: clean skip,
             # not an inconsistency
             return ScrubResult(oid)
-        hinfo = pg.rmw.hinfo(oid)
+        hinfo, dissent = self._consensus_hinfo(pg, oid)
         if hinfo is None:
-            key = self._my_key(pg, oid)
-            try:
-                hinfo = HashInfo.from_bytes(
-                    self.store.getattr(key, HINFO_KEY)
-                )
-            except (FileNotFoundError, KeyError, TypeError, ValueError):
-                result = ScrubResult(oid)
-                result.errors.append(ScrubError(-1, "missing_attr"))
-                return result
+            result = ScrubResult(oid)
+            result.errors.append(ScrubError(
+                -1, "hinfo_conflict" if dissent else "missing_attr"
+            ))
+            return result
+        if dissent:
+            self.log.info(
+                "scrub", oid + ":", "hinfo dissent from shards", dissent,
+                "- majority copy wins"
+            )
         result = be_deep_scrub(
             pg.sinfo, _ScrubBackendView(pg), oid, hinfo=hinfo
         )
@@ -1484,6 +1491,57 @@ class OSDDaemon:
             except Exception as e:
                 result.errors.append(ScrubError(-1, "read_error", str(e)))
         return result
+
+    def _consensus_hinfo(
+        self, pg: _PG, oid: str
+    ) -> "tuple[HashInfo | None, list[int]]":
+        """(majority HashInfo, dissenting shard positions).
+
+        Every shard's store carries its own copy of the object's
+        HashInfo attr; trusting only the PRIMARY's copy lets a
+        divergent ex-primary 'repair' the good majority into garbage
+        (its own attr vouches for its own divergent bytes). Scrub
+        therefore VOTES: fetch the attr from every reachable member
+        and take the majority bytes value — the authoritative-copy
+        election the reference gets from peering/auth_log_shard,
+        scoped to the integrity attr scrub actually consumes."""
+        votes: dict[bytes, list[int]] = {}
+        reachable = self.peers.avail_shards() | {self.osd_id}
+        for pos, osd in enumerate(pg.acting):
+            if osd == SHARD_NONE or osd not in reachable:
+                continue
+            key = shard_key(oid, pos)
+            try:
+                if osd == self.osd_id:
+                    raw = self.store.getattrs(key).get(HINFO_KEY)
+                else:
+                    raw = self.peers.get_attrs(
+                        osd, key, [HINFO_KEY]
+                    ).get(HINFO_KEY)
+            except Exception:
+                continue  # unreachable/absent: abstains
+            if raw:
+                votes.setdefault(bytes(raw), []).append(pos)
+        if not votes:
+            return None, []
+        counts = sorted((len(h) for h in votes.values()), reverse=True)
+        if len(counts) > 1 and counts[0] == counts[1]:
+            # TIE: no value may direct repair — a 1-1 split where the
+            # divergent primary's copy wins by dict order is exactly
+            # the failure this vote exists to prevent. Report the
+            # conflict; repair waits for more members to return.
+            return None, sorted(
+                pos for holders in votes.values() for pos in holders
+            )
+        winner = max(votes.items(), key=lambda kv: len(kv[1]))[0]
+        dissent = sorted(
+            pos for raw, holders in votes.items()
+            if raw != winner for pos in holders
+        )
+        try:
+            return HashInfo.from_bytes(winner), dissent
+        except (TypeError, ValueError):
+            return None, dissent
 
     def scrub_all(self, repair: bool = False) -> "dict":
         """Scrub every PG this daemon currently leads."""

@@ -32,6 +32,8 @@ MSG_OSD_OP = 114              # MOSDOp (client op to the primary)
 MSG_OSD_OP_REPLY = 115        # MOSDOpReply
 MSG_PG_LIST = 116             # backfill object discovery
 MSG_PG_LIST_REPLY = 117
+MSG_GET_ATTRS = 118           # per-shard attr fetch (scrub consensus)
+MSG_GET_ATTRS_REPLY = 119
 
 VERSION = 1
 
@@ -347,6 +349,89 @@ class PGListReply:
         )
 
 
+@dataclass
+class GetAttrs:
+    """Fetch named attrs from one shard's store — the getattr sub-op
+    (the extension point deep scrub needs to vote on HashInfo copies
+    instead of trusting the primary's own)."""
+
+    tid: int
+    shard: int
+    oid: str          # full store key (shard_key applied by caller)
+    names: list[str]
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "get_attrs",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "oid": self.oid,
+                    "names": self.names,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "GetAttrs":
+        h = _parse(segments[0], "get_attrs")
+        return cls(h["tid"], h["shard"], h["oid"], list(h["names"]))
+
+
+@dataclass
+class GetAttrsReply:
+    """Requested attrs as raw bytes (hex on the wire); absent names
+    map to None, a missing object sets error."""
+
+    tid: int
+    shard: int
+    attrs: dict = field(default_factory=dict)  # name -> bytes | None
+    error: str | None = None
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "get_attrs_reply",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "attrs": {
+                        k: (v.hex() if v is not None else None)
+                        for k, v in self.attrs.items()
+                    },
+                    "error": self.error,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "GetAttrsReply":
+        h = _parse(segments[0], "get_attrs_reply")
+        return cls(
+            h["tid"],
+            h["shard"],
+            {
+                k: (bytes.fromhex(v) if v is not None else None)
+                for k, v in h["attrs"].items()
+            },
+            h.get("error"),
+        )
+
+
+def serve_get_attrs(store, shard_id: int, conn, msg: "GetAttrs") -> None:
+    """Serve one GetAttrs against a local store — shared by the
+    shard-server and OSD-daemon dispatchers (one source of truth for
+    the absent-name/enoent semantics)."""
+    try:
+        attrs = store.getattrs(msg.oid)
+        conn.send(GetAttrsReply(
+            msg.tid, shard_id, {n: attrs.get(n) for n in msg.names},
+        ))
+    except FileNotFoundError:
+        conn.send(GetAttrsReply(msg.tid, shard_id, error="enoent"))
+
+
 _DECODERS = {
     MSG_EC_SUB_WRITE: ECSubWrite.decode,
     MSG_EC_SUB_WRITE_REPLY: ECSubWriteReply.decode,
@@ -358,6 +443,8 @@ _DECODERS = {
     MSG_OSD_OP_REPLY: OSDOpReply.decode,
     MSG_PG_LIST: PGList.decode,
     MSG_PG_LIST_REPLY: PGListReply.decode,
+    MSG_GET_ATTRS: GetAttrs.decode,
+    MSG_GET_ATTRS_REPLY: GetAttrsReply.decode,
 }
 
 _TYPE_OF = {
@@ -371,6 +458,8 @@ _TYPE_OF = {
     OSDOpReply: MSG_OSD_OP_REPLY,
     PGList: MSG_PG_LIST,
     PGListReply: MSG_PG_LIST_REPLY,
+    GetAttrs: MSG_GET_ATTRS,
+    GetAttrsReply: MSG_GET_ATTRS_REPLY,
 }
 
 

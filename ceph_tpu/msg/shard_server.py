@@ -37,6 +37,8 @@ from .messages import (
     ECSubReadReply,
     ECSubWrite,
     ECSubWriteReply,
+    GetAttrs,
+    GetAttrsReply,
     PGList,
     PGListReply,
     Ping,
@@ -76,6 +78,10 @@ class ShardServer:
     def _dispatch(self, conn: Connection, msg) -> None:
         if isinstance(msg, Ping):
             conn.send(Pong(msg.tid, self.shard))
+        elif isinstance(msg, GetAttrs):
+            from .messages import serve_get_attrs
+
+            serve_get_attrs(self.store, self.shard, conn, msg)
         elif isinstance(msg, ECSubWrite):
             self._local.submit_shard_txn(
                 self.shard,
@@ -177,7 +183,8 @@ class NetShardBackend:
             self._last_seen[msg.shard] = time.monotonic()
             return
         if not isinstance(
-            msg, (ECSubWriteReply, ECSubReadReply, PGListReply)
+            msg,
+            (ECSubWriteReply, ECSubReadReply, PGListReply, GetAttrsReply),
         ):
             return  # a reflected request must never satisfy an RPC
         with self._lock:
@@ -339,6 +346,26 @@ class NetShardBackend:
         if isinstance(result, Exception):
             raise result
         return result.oids
+
+    def get_attrs(
+        self, shard: int, oid: str, names: list[str]
+    ) -> dict:
+        """Synchronous attr fetch from one shard's store (the getattr
+        sub-op): name -> bytes | None. Raises on enoent/unreachable."""
+        tid = next(self._tids)
+        out: dict[str, object] = {}
+        self._register(
+            tid, shard, oid, lambda r: out.update(r=r), is_read=True
+        )
+        if not self._send(shard, GetAttrs(tid, shard, oid, names), tid):
+            raise ConnectionError(f"osd.{shard} unreachable for attrs")
+        self.drain_until(lambda: "r" in out, timeout=self.timeout)
+        result = out["r"]
+        if isinstance(result, Exception):
+            raise result
+        if result.error:
+            raise FileNotFoundError(oid)
+        return result.attrs
 
     def submit_shard_txn(
         self, shard: int, txn: Transaction, ack: Callable[[], None]

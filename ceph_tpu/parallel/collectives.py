@@ -80,19 +80,15 @@ def ring_parity(
         # slice arriving from d-1. After sp-1 steps it owns the FULLY
         # reduced slice (d + 1) mod sp.
         def rs_step(t, carry):
-            send = jax.lax.cond(
-                t == 0,
-                lambda: slice_at(partial, (d - t) % sp),
-                lambda: carry,
-            )
-            recv = jax.lax.ppermute(send, "sp", fwd)
+            recv = jax.lax.ppermute(carry, "sp", fwd)
             return jnp.bitwise_xor(
                 recv, slice_at(partial, (d - t - 1) % sp)
             )
 
+        # carry starts as this device's own slice d: at step t the
+        # carry IS the partially-reduced slice (d - t) mod sp
         mine = jax.lax.fori_loop(
-            0, sp - 1, rs_step,
-            jnp.zeros(partial.shape[:-1] + (w,), jnp.uint8),
+            0, sp - 1, rs_step, slice_at(partial, d)
         )
         my_slice = (d + 1) % sp
 
@@ -146,7 +142,7 @@ def _pick_geometry(total: int, n_dev: int) -> tuple[int, int, int]:
     zeros is free), so awkward lengths never degenerate into tiny
     folds — the object pads up to n_dev * npieces * fb."""
     local = -(-total // n_dev)
-    fb = min(FOLD_BLOCK_MAX, ((local + 63) // 64) * 64)
+    fb = min(FOLD_BLOCK_MAX, max(64, ((local + 63) // 64) * 64))
     npieces = -(-local // fb)
     return fb, npieces, n_dev * npieces * fb
 

@@ -121,3 +121,94 @@ def test_scrub_all_covers_every_led_pg(cluster):
                 seen.add(r.oid)
     pool_id = mon.osdmap.pools["ecpool"].pool_id
     assert seen == {make_loc(pool_id, f"o{i}") for i in range(8)}
+
+
+def test_divergent_primary_hinfo_loses_the_vote(cluster):
+    """A primary whose OWN shard and HashInfo attr are divergent (the
+    returning ex-primary case) must not 'repair' the good majority
+    into its garbage: scrub votes on the HashInfo copies across
+    members, the primary's minority copy loses, and repair rebuilds
+    the PRIMARY's shard from the majority."""
+    from ceph_tpu.checksum.host import crc32c as crc_host
+    from ceph_tpu.cluster.osd_daemon import HINFO_KEY
+    from ceph_tpu.pipeline.hashinfo import HashInfo
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    data = payload(4_000, seed=4)
+    io.write("obj", data)
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    primary = acting[0]
+    loc = make_loc(mon.osdmap.pools["ecpool"].pool_id, "obj")
+    key = shard_key(loc, 0)
+    store = daemons[primary].store
+    # Divergence: garbage bytes on the primary's own shard AND a
+    # self-consistent HashInfo vouching for them (what a divergent
+    # write would have stamped).
+    garbage = b"\x66" * store.stat(key)
+    hinfo = HashInfo.from_bytes(store.getattr(key, HINFO_KEY))
+    # recompute shard-0 crc over the garbage exactly as appends do
+    hinfo.cumulative_shard_hashes[0] = crc_host(0xFFFFFFFF, garbage)
+    store.queue_transactions(
+        Transaction().write(key, 0, garbage)
+        .setattr(key, HINFO_KEY, hinfo.to_bytes())
+    )
+    # drop the primary's in-memory hinfo so scrub re-reads attrs
+    pg = daemons[primary]._get_pg("ecpool", mon.osdmap.object_to_pg("ecpool", "obj"))
+    pg.rmw._hinfo.pop(loc, None)
+
+    results = daemons[primary].scrub_pg(
+        "ecpool", mon.osdmap.object_to_pg("ecpool", "obj"), repair=True
+    )
+    row = next(r for r in results if r.oid == loc)
+    bad = {e.shard for e in row.errors if e.shard >= 0}
+    assert bad == {0}, f"majority must win the vote; flagged {bad}"
+    assert row.repaired
+    # the client reads the ORIGINAL data (good shards untouched,
+    # primary's divergent shard rebuilt)
+    assert io.read("obj") == data
+
+
+def test_hinfo_vote_tie_never_directs_repair(cluster):
+    """1-1 attr split (divergent primary + one reachable good replica)
+    is a TIE: scrub must refuse to elect a winner — no repair runs,
+    and the good shard is left untouched."""
+    from ceph_tpu.checksum.host import crc32c as crc_host
+    from ceph_tpu.cluster.osd_daemon import HINFO_KEY
+    from ceph_tpu.pipeline.hashinfo import HashInfo
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("obj", payload(3_000, seed=6))
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    primary = acting[0]
+    loc = make_loc(mon.osdmap.pools["ecpool"].pool_id, "obj")
+    key = shard_key(loc, 0)
+    store = daemons[primary].store
+    garbage = b"\x55" * store.stat(key)
+    hinfo = HashInfo.from_bytes(store.getattr(key, HINFO_KEY))
+    hinfo.cumulative_shard_hashes[0] = crc_host(0xFFFFFFFF, garbage)
+    store.queue_transactions(
+        Transaction().write(key, 0, garbage)
+        .setattr(key, HINFO_KEY, hinfo.to_bytes())
+    )
+    pg = daemons[primary]._get_pg(
+        "ecpool", mon.osdmap.object_to_pg("ecpool", "obj")
+    )
+    pg.rmw._hinfo.pop(loc, None)
+    # leave exactly ONE good replica reachable: 1-1 tie with the primary
+    keep = acting[1]
+    for pos, osd in enumerate(acting):
+        if osd not in (primary, keep):
+            daemons[primary].peers.down_shards.add(osd)
+    good_replica_bytes = daemons[keep].store.read(shard_key(loc, 1))
+    results = daemons[primary].scrub_pg(
+        "ecpool", mon.osdmap.object_to_pg("ecpool", "obj"), repair=True
+    )
+    row = next(r for r in results if r.oid == loc)
+    assert any(e.kind == "hinfo_conflict" for e in row.errors), row.errors
+    assert not row.repaired
+    # the good replica's shard bytes are untouched
+    assert daemons[keep].store.read(shard_key(loc, 1)) == good_replica_bytes
+    for pos, osd in enumerate(acting):
+        daemons[primary].peers.down_shards.discard(osd)
