@@ -40,7 +40,6 @@ from ceph_tpu.msg.messages import (
     ECSubWrite,
     ECSubWriteReply,
     GetAttrs,
-    GetAttrsReply,
     OSDOp,
     OSDOpReply,
     PGList,
@@ -48,6 +47,7 @@ from ceph_tpu.msg.messages import (
     Ping,
     Pong,
 )
+from ceph_tpu.msg.messages import serve_get_attrs
 from ceph_tpu.msg.messenger import Connection, Messenger
 from ceph_tpu.msg.shard_server import NetShardBackend
 from ceph_tpu.codecs import registry
@@ -860,8 +860,6 @@ class OSDDaemon:
         elif isinstance(msg, ECSubRead):
             self._handle_sub_read(conn, msg)
         elif isinstance(msg, GetAttrs):
-            from ceph_tpu.msg.messages import serve_get_attrs
-
             serve_get_attrs(self.store, self.osd_id, conn, msg)
         elif isinstance(msg, PGList):
             self._handle_pg_list(conn, msg)
@@ -1486,56 +1484,127 @@ class OSDDaemon:
         bad = sorted({e.shard for e in result.errors if e.shard >= 0})
         if repair and bad:
             try:
+                # the rebuilt shards must carry the ELECTED hinfo, not
+                # whatever (possibly divergent) copy the rmw cache was
+                # primed with — else the dissenting attr survives the
+                # repair and every later scrub re-flags the shard
+                pg.rmw.prime_object(
+                    oid, self._object_size(pg, oid), hinfo
+                )
+                pg.rmw._hinfo[oid] = hinfo
                 pg.recovery.recover_object(oid, set(bad))
                 result.repaired = True
             except Exception as e:
                 result.errors.append(ScrubError(-1, "read_error", str(e)))
         return result
 
+    def _gather_hinfo_votes(
+        self, pg: _PG, oid: str
+    ) -> "dict[bytes, tuple[list[int], tuple[int, int]]]":
+        """attr-bytes -> (holder positions, newest accompanying OI
+        eversion). One concurrent fan-out: all remote fetches go out
+        before any reply is awaited (no per-member round trips, no
+        long _op_lock stalls on a slow peer). Members still under
+        catch-up (backend.recovering) do not vote — their attrs are
+        mid-replay by definition."""
+        votes: dict[bytes, tuple[list[int], tuple[int, int]]] = {}
+
+        def tally(pos: int, attrs: dict) -> None:
+            raw = attrs.get(HINFO_KEY)
+            if not raw:
+                return
+            ev = (0, 0)
+            oi = attrs.get(OI_KEY)
+            if oi:
+                try:
+                    _sz, ev = parse_oi(oi)
+                except ValueError:
+                    pass
+            holders, best = votes.setdefault((bytes(raw)), ([], (0, 0)))
+            holders.append(pos)
+            votes[bytes(raw)] = (holders, max(best, ev))
+
+        reachable = self.peers.avail_shards() | {self.osd_id}
+        pending: set[int] = set()
+
+        def on_reply(pos: int, reply) -> None:
+            pending.discard(pos)
+            if not isinstance(reply, Exception) and not reply.error:
+                tally(pos, reply.attrs)
+
+        for pos, osd in enumerate(pg.acting):
+            if (
+                osd == SHARD_NONE
+                or osd not in reachable
+                or pos in pg.backend.recovering
+            ):
+                continue
+            key = shard_key(oid, pos)
+            if osd == self.osd_id:
+                try:
+                    attrs = self.store.getattrs(key)
+                    tally(pos, {
+                        HINFO_KEY: attrs.get(HINFO_KEY),
+                        OI_KEY: attrs.get(OI_KEY),
+                    })
+                except FileNotFoundError:
+                    pass
+                continue
+            if self.peers.get_attrs_async(
+                osd, key, [HINFO_KEY, OI_KEY],
+                lambda r, p=pos: on_reply(p, r),
+            ):
+                pending.add(pos)
+        if pending:
+            try:
+                self.peers.drain_until(
+                    lambda: not pending, timeout=self.op_timeout
+                )
+            except TimeoutError:
+                pass  # non-repliers abstain
+        return votes
+
     def _consensus_hinfo(
         self, pg: _PG, oid: str
     ) -> "tuple[HashInfo | None, list[int]]":
-        """(majority HashInfo, dissenting shard positions).
+        """(elected HashInfo, dissenting shard positions).
 
         Every shard's store carries its own copy of the object's
         HashInfo attr; trusting only the PRIMARY's copy lets a
-        divergent ex-primary 'repair' the good majority into garbage
-        (its own attr vouches for its own divergent bytes). Scrub
-        therefore VOTES: fetch the attr from every reachable member
-        and take the majority bytes value — the authoritative-copy
-        election the reference gets from peering/auth_log_shard,
-        scoped to the integrity attr scrub actually consumes."""
-        votes: dict[bytes, list[int]] = {}
-        reachable = self.peers.avail_shards() | {self.osd_id}
-        for pos, osd in enumerate(pg.acting):
-            if osd == SHARD_NONE or osd not in reachable:
-                continue
-            key = shard_key(oid, pos)
-            try:
-                if osd == self.osd_id:
-                    raw = self.store.getattrs(key).get(HINFO_KEY)
-                else:
-                    raw = self.peers.get_attrs(
-                        osd, key, [HINFO_KEY]
-                    ).get(HINFO_KEY)
-            except Exception:
-                continue  # unreachable/absent: abstains
-            if raw:
-                votes.setdefault(bytes(raw), []).append(pos)
+        divergent ex-primary 'repair' the good majority into garbage.
+        Election, in order (the auth_log_shard role scoped to the
+        integrity attr scrub consumes):
+
+        1. If this primary has LIVE history for the object (in-memory
+           rmw state or an in-window pg log entry — trustworthy, unlike
+           a cold-boot attr), the copy whose accompanying OI eversion
+           matches it wins regardless of count: two stale copies must
+           not outvote the one member holding the committed write.
+        2. Otherwise plurality of the cast votes; a TIE elects nobody
+           (hinfo_conflict, no repair) — a coin flip must never
+           overwrite a good shard."""
+        votes = self._gather_hinfo_votes(pg, oid)
         if not votes:
             return None, []
-        counts = sorted((len(h) for h in votes.values()), reverse=True)
-        if len(counts) > 1 and counts[0] == counts[1]:
-            # TIE: no value may direct repair — a 1-1 split where the
-            # divergent primary's copy wins by dict order is exactly
-            # the failure this vote exists to prevent. Report the
-            # conflict; repair waits for more members to return.
-            return None, sorted(
-                pos for holders in votes.values() for pos in holders
+        live_ev = pg.rmw.object_eversion(oid) or pg.pglog.last_eversion(oid)
+        winner = None
+        if live_ev is not None and live_ev != (0, 0):
+            matching = [
+                raw for raw, (_h, ev) in votes.items() if ev == live_ev
+            ]
+            if len(matching) == 1:
+                winner = matching[0]
+        if winner is None:
+            counts = sorted(
+                (len(h) for h, _ev in votes.values()), reverse=True
             )
-        winner = max(votes.items(), key=lambda kv: len(kv[1]))[0]
+            if len(counts) > 1 and counts[0] == counts[1]:
+                return None, sorted(
+                    pos for h, _ev in votes.values() for pos in h
+                )
+            winner = max(votes.items(), key=lambda kv: len(kv[1][0]))[0]
         dissent = sorted(
-            pos for raw, holders in votes.items()
+            pos for raw, (holders, _ev) in votes.items()
             if raw != winner for pos in holders
         )
         try:

@@ -347,17 +347,25 @@ class NetShardBackend:
             raise result
         return result.oids
 
+    def get_attrs_async(
+        self, shard: int, oid: str, names: list[str], cb
+    ) -> bool:
+        """Async attr fetch (the read_shard_async pattern): ``cb`` gets
+        a GetAttrsReply, an Exception, or is never called when the
+        send itself fails (returns False so the caller can count)."""
+        tid = next(self._tids)
+        self._register(tid, shard, oid, cb, is_read=True)
+        return self._send(shard, GetAttrs(tid, shard, oid, names), tid)
+
     def get_attrs(
         self, shard: int, oid: str, names: list[str]
     ) -> dict:
         """Synchronous attr fetch from one shard's store (the getattr
         sub-op): name -> bytes | None. Raises on enoent/unreachable."""
-        tid = next(self._tids)
         out: dict[str, object] = {}
-        self._register(
-            tid, shard, oid, lambda r: out.update(r=r), is_read=True
-        )
-        if not self._send(shard, GetAttrs(tid, shard, oid, names), tid):
+        if not self.get_attrs_async(
+            shard, oid, names, lambda r: out.update(r=r)
+        ):
             raise ConnectionError(f"osd.{shard} unreachable for attrs")
         self.drain_until(lambda: "r" in out, timeout=self.timeout)
         result = out["r"]

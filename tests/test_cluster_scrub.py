@@ -167,6 +167,17 @@ def test_divergent_primary_hinfo_loses_the_vote(cluster):
     # the client reads the ORIGINAL data (good shards untouched,
     # primary's divergent shard rebuilt)
     assert io.read("obj") == data
+    # ... and the repair stamped the ELECTED hinfo onto the rebuilt
+    # shard: the divergent attr must not survive to re-flag forever
+    repaired_attr = store.getattr(key, HINFO_KEY)
+    other = daemons[acting[1]].store.getattr(shard_key(loc, 1), HINFO_KEY)
+    assert repaired_attr == other
+    (res2,) = [
+        r for r in daemons[primary].scrub_pg(
+            "ecpool", mon.osdmap.object_to_pg("ecpool", "obj")
+        ) if r.oid == loc
+    ]
+    assert res2.ok, "second scrub must be clean after repair"
 
 
 def test_hinfo_vote_tie_never_directs_repair(cluster):
@@ -212,3 +223,57 @@ def test_hinfo_vote_tie_never_directs_repair(cluster):
     assert daemons[keep].store.read(shard_key(loc, 1)) == good_replica_bytes
     for pos, osd in enumerate(acting):
         daemons[primary].peers.down_shards.discard(osd)
+
+
+
+def test_live_history_beats_stale_plurality(cluster):
+    """Two members whose shard+attrs regressed to a pre-overwrite
+    state (byte-identical, so they'd win a pure plurality) must NOT
+    outvote the primary's copy when the primary holds LIVE history of
+    the committed write: the eversion anchor elects the committed
+    attr and repair fixes the STALE pair."""
+    from ceph_tpu.cluster.osd_daemon import HINFO_KEY, OI_KEY
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    # page-aligned sizes (k=3 shards x 4096-byte pages): cumulative
+    # HashInfo covers pure page-aligned appends only; anything else is
+    # an RMW that clears coverage and would make scrub vacuous here
+    io.write("obj", payload(12_288, seed=8))
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    loc = make_loc(mon.osdmap.pools["ecpool"].pool_id, "obj")
+    # snapshot two non-primary members' v1 shard state
+    stale_pos = [1, 2]
+    snap = {}
+    for pos in stale_pos:
+        st = daemons[acting[pos]].store
+        key = shard_key(loc, pos)
+        snap[pos] = (
+            st.read(key),
+            st.getattr(key, HINFO_KEY),
+            st.getattr(key, OI_KEY),
+        )
+    # v2 is a page-aligned APPEND: cumulative HashInfo extends
+    tail = payload(12_288, seed=9)
+    io.write("obj", tail, offset=12_288)
+    data2 = payload(12_288, seed=8) + tail
+    # regress the pair to v1 (as if the overwrite never reached them)
+    for pos in stale_pos:
+        st = daemons[acting[pos]].store
+        key = shard_key(loc, pos)
+        blob, h, oi = snap[pos]
+        st.queue_transactions(
+            Transaction().truncate(key, len(blob)).write(key, 0, blob)
+            .setattr(key, HINFO_KEY, h).setattr(key, OI_KEY, oi)
+        )
+    primary = acting[0]
+    results = daemons[primary].scrub_pg(
+        "ecpool", mon.osdmap.object_to_pg("ecpool", "obj"), repair=True
+    )
+    row = next(r for r in results if r.oid == loc)
+    bad = {e.shard for e in row.errors if e.shard >= 0}
+    assert bad == set(stale_pos), (
+        f"the stale pair must lose to live history; flagged {bad}"
+    )
+    assert row.repaired
+    assert io.read("obj") == data2

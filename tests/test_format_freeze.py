@@ -1,0 +1,138 @@
+"""On-disk / on-wire format freeze — golden byte vectors.
+
+The codec corpus (tests/corpus/v0) pins ENCODE outputs; this file pins
+the runtime's serialization formats the same way: an accidental layout
+change in the wire frame header, the KV batch record, the framed-log
+record, the OI attr, or the transaction op codec would silently break
+mixed-version clusters and stored data. Each vector was generated once
+and must reproduce byte-for-byte forever (additions must come as NEW
+versions/flags, never relayouts — the reference's encode/decode
+versioning discipline, src/include/encoding.h).
+"""
+
+import pytest
+
+
+class TestWireFrame:
+    GOLDEN = bytes.fromhex(
+        "43547632070000022a000000000000000a0000008aef3e8d0d000000"
+        "c623f6106865616465722d6973687061796c6f61642d6279746573"
+    )
+
+    def test_frame_bytes_frozen(self):
+        from ceph_tpu.msg.wire import encode_frame
+
+        assert (
+            encode_frame(7, 42, [b"header-ish", b"payload-bytes"])
+            == self.GOLDEN
+        )
+
+    def test_golden_decodes(self):
+        from ceph_tpu.msg.wire import frame_from_buffer
+
+        assert frame_from_buffer(self.GOLDEN) == (
+            7, 42, [b"header-ish", b"payload-bytes"],
+        )
+
+
+class TestKVBatch:
+    GOLDEN = bytes.fromhex(
+        "0300000000010004000000060000004f6f69643100ff646174610101"
+        "0001000000000000004f7802010000000000000000005a"
+    )
+
+    def test_batch_bytes_frozen(self):
+        from ceph_tpu.store.kvstore import KVTransaction
+
+        txn = (
+            KVTransaction()
+            .set("O", "oid1", b"\x00\xffdata")
+            .rmkey("O", "x")
+            .rmkeys_by_prefix("Z")
+        )
+        assert txn.encode() == self.GOLDEN
+
+    def test_golden_decodes(self):
+        from ceph_tpu.store.kvstore import KVTransaction
+
+        txn = KVTransaction.decode(self.GOLDEN)
+        assert txn.ops == [
+            (0, "O", "oid1", b"\x00\xffdata"),
+            (1, "O", "x", b""),
+            (2, "Z", "", b""),
+        ]
+
+
+class TestFramedLog:
+    GOLDEN = bytes.fromhex("0e0000006e7952587265636f72642d7061796c6f6164")
+
+    def test_record_bytes_frozen(self, tmp_path):
+        from ceph_tpu.store import framed_log
+
+        p = str(tmp_path / "log")
+        framed_log.append(p, b"record-payload", sync=False)
+        assert open(p, "rb").read() == self.GOLDEN
+
+    def test_golden_replays(self, tmp_path):
+        from ceph_tpu.store import framed_log
+
+        p = str(tmp_path / "log")
+        with open(p, "wb") as f:
+            f.write(self.GOLDEN)
+        assert framed_log.replay(p) == [b"record-payload"]
+
+
+class TestOIAttr:
+    def test_pack_frozen(self):
+        from ceph_tpu.pipeline.rmw import pack_oi
+
+        assert pack_oi(12345, (7, 99)) == b"12345:7:99"
+
+    def test_parse_both_generations(self):
+        from ceph_tpu.pipeline.rmw import parse_oi
+
+        assert parse_oi(b"12345:7:99") == (12345, (7, 99))
+        assert parse_oi(b"12345") == (12345, (0, 0))  # pre-eversion
+        with pytest.raises(ValueError):
+            parse_oi(b"12:9")
+
+
+class TestTransactionCodec:
+    GOLDEN_TXN = bytes.fromhex(
+        "010400000001030000006f626a40000000000000000500000000000000"
+        "0000000005000000627974657305030000006f626a0000000000000000"
+        "00000000000000000100000061010000007603030000006f626a640000"
+        "0000000000000000000000000000000000000000000404000000676f6e"
+        "65000000000000000000000000000000000000000000000000"
+    )
+
+    def test_txn_payload_frozen(self):
+        """The binary op-list payload of an ECSubWrite (explicit stable
+        op codes — enum reorder must never re-number the wire)."""
+        from ceph_tpu.msg.messages import ECSubWrite
+        from ceph_tpu.store import Transaction
+
+        txn = (
+            Transaction()
+            .write("obj", 64, b"bytes")
+            .setattr("obj", "a", b"v")
+            .truncate("obj", 100)
+            .remove("gone")
+        )
+        segs = ECSubWrite(5, 2, txn).encode()
+        assert len(segs) == 2
+        assert segs[1] == self.GOLDEN_TXN
+
+    def test_golden_decodes(self):
+        from ceph_tpu.msg.messages import ECSubWrite
+        from ceph_tpu.store import OpKind
+
+        hdr = (
+            b'{"v": 1, "kind": "sub_write", "tid": 5, "shard": 2}'
+        )
+        msg = ECSubWrite.decode([hdr, self.GOLDEN_TXN])
+        kinds = [op.kind for op in msg.txn.ops]
+        assert kinds == [
+            OpKind.WRITE, OpKind.SETATTR, OpKind.TRUNCATE, OpKind.REMOVE,
+        ]
+        assert msg.txn.ops[0].data == b"bytes"
