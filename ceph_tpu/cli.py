@@ -72,7 +72,10 @@ class Cluster:
         self.mon = Monitor(
             initial=initial, commit_fn=self.mon_store.append,
             history=history,
+            pool_id_floor=self.mon_store.pool_id_floor(),
         )
+        if len(history) > self.mon_store.keep:
+            self.mon_store.trim(initial)
         self.daemons: dict[int, OSDDaemon] = {}
         for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
             if not name.startswith("osd."):

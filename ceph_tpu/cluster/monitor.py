@@ -51,6 +51,7 @@ class Monitor:
         commit_fn: Callable[[Incremental], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
         history: "list[Incremental] | None" = None,
+        pool_id_floor: int = 0,
     ) -> None:
         self.osdmap = initial or OSDMap()
         self._commit_fn = commit_fn
@@ -68,7 +69,11 @@ class Monitor:
         # reused — stale shard keys on disk encode only the pool id,
         # and a reused id would adopt them into the new pool), so the
         # high-water mark comes from the full history when available
-        ever = [p.pool_id for p in self.osdmap.pools.values()]
+        # pool_id_floor covers history trimmed out of the store: a
+        # pool created and deleted before the window must still never
+        # have its id reused
+        ever = [pool_id_floor]
+        ever.extend(p.pool_id for p in self.osdmap.pools.values())
         for incr in history or ():
             ever.extend(p.pool_id for p in incr.new_pools)
         self._next_pool_id = 1 + max(ever, default=0)

@@ -1491,7 +1491,6 @@ class OSDDaemon:
                 pg.rmw.prime_object(
                     oid, self._object_size(pg, oid), hinfo
                 )
-                pg.rmw._hinfo[oid] = hinfo
                 pg.recovery.recover_object(oid, set(bad))
                 result.repaired = True
             except Exception as e:
@@ -1547,8 +1546,8 @@ class OSDDaemon:
                         HINFO_KEY: attrs.get(HINFO_KEY),
                         OI_KEY: attrs.get(OI_KEY),
                     })
-                except FileNotFoundError:
-                    pass
+                except Exception:
+                    pass  # corrupt/missing attrs: this shard abstains
                 continue
             if self.peers.get_attrs_async(
                 osd, key, [HINFO_KEY, OI_KEY],
@@ -1586,7 +1585,12 @@ class OSDDaemon:
         votes = self._gather_hinfo_votes(pg, oid)
         if not votes:
             return None, []
-        live_ev = pg.rmw.object_eversion(oid) or pg.pglog.last_eversion(oid)
+        # ONLY write-origin evidence anchors the election: rmw stamps
+        # recorded by this pipeline's own writes, or in-window pg log
+        # entries. object_eversion may be primed from the primary's
+        # own cold attr — which is exactly what a divergent ex-primary
+        # would use to elect itself.
+        live_ev = pg.rmw.live_eversion(oid) or pg.pglog.last_eversion(oid)
         winner = None
         if live_ev is not None and live_ev != (0, 0):
             matching = [

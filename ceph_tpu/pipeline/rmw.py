@@ -334,6 +334,11 @@ class RMWPipeline:
         #: refreshes it on map change
         self.epoch = 0
         self._eversions: dict[str, tuple[int, int]] = {}
+        #: stamps recorded by writes THIS pipeline instance performed
+        #: (never seeded from stored attrs): the only eversions strong
+        #: enough to anchor a scrub election — a cold-boot attr may
+        #: itself be divergent
+        self._live_eversions: dict[str, tuple[int, int]] = {}
         #: oid -> backend-read failure awaiting its op (degraded RMW
         #: read failed; the op aborts in _cache_ready, in order)
         self._read_errors: dict[str, Exception] = {}
@@ -432,6 +437,7 @@ class RMWPipeline:
                 self._object_sizes.pop(oid, None)
                 self._hinfo.pop(oid, None)
                 self._eversions.pop(oid, None)
+                self._live_eversions.pop(oid, None)
                 for shard in sorted(live):
                     # touch+remove: no-op on shards that never got the
                     # object (a hole at write time)
@@ -506,8 +512,14 @@ class RMWPipeline:
         return self._object_sizes.get(oid, 0)
 
     def object_eversion(self, oid: str) -> tuple[int, int] | None:
-        """Last committed write's (epoch, tid) stamp, if known."""
+        """Last known (epoch, tid) stamp — may come from a stored
+        attr (prime_object); use live_eversion when trust matters."""
         return self._eversions.get(oid)
+
+    def live_eversion(self, oid: str) -> tuple[int, int] | None:
+        """(epoch, tid) of a write THIS pipeline performed; None for
+        state only known from stored attrs."""
+        return self._live_eversions.get(oid)
 
     def prime_object(
         self, oid: str, size: int, hinfo: HashInfo | None = None,
@@ -634,6 +646,7 @@ class RMWPipeline:
         self._generate_transactions(op, new_map, new_size)
         self._object_sizes[op.oid] = new_size
         self._eversions[op.oid] = (self.epoch, op.tid)
+        self._live_eversions[op.oid] = (self.epoch, op.tid)
 
     def _get_hinfo(self, oid: str) -> HashInfo:
         if oid not in self._hinfo:

@@ -197,3 +197,79 @@ def test_cli_secure_cluster(cdir, tmp_path, capsys):
     out_file = tmp_path / "out"
     run(capsys, "-d", cdir, "get", "p", "obj", str(out_file))
     assert out_file.read_bytes() == blob.read_bytes()
+
+
+class TestMonStoreKV:
+    """MonStore over KeyValueDB: legacy-log migration, paxos-style
+    trim with full-map snapshot, and the pool-id floor surviving
+    trimmed history."""
+
+    def mkincr(self, epoch, pools=()):
+        from ceph_tpu.cluster.osdmap import PoolSpec
+
+        return Incremental(
+            epoch=epoch,
+            new_osds=(OSDInfo(epoch % 3, 1.0, "z", True, True,
+                              ("h", 7000 + epoch)),),
+            new_pools=tuple(
+                PoolSpec(f"p{pid}", pid, 8, "prof", "isa", 2, 1)
+                for pid in pools
+            ),
+        )
+
+    def test_legacy_log_migrates_once(self, tmp_path):
+        from ceph_tpu.store import framed_log
+
+        path = str(tmp_path / "mon" / "store.log")
+        import os
+
+        os.makedirs(os.path.dirname(path))
+        m = OSDMap()
+        incrs = [self.mkincr(i + 1) for i in range(4)]
+        for incr in incrs:
+            framed_log.append(path, incr.to_bytes())
+            m = m.apply(incr)
+        store = MonStore(path)
+        assert not os.path.exists(path)  # legacy absorbed + removed
+        replayed, hist = store.replay()
+        assert replayed.to_bytes() == m.to_bytes()
+        assert len(hist) == 4
+        # reopening keeps the content (migration is one-shot)
+        replayed2, _ = MonStore(path).replay()
+        assert replayed2.to_bytes() == m.to_bytes()
+
+    def test_trim_snapshots_and_bounds_history(self, tmp_path):
+        path = str(tmp_path / "mon" / "store.log")
+        store = MonStore(path, keep=3)
+        m = OSDMap()
+        for i in range(10):
+            incr = self.mkincr(i + 1)
+            store.append(incr)
+            m = m.apply(incr)
+        dropped = store.trim(m)
+        assert dropped == 7  # epochs 1..7 below the keep=3 window
+        replayed, hist = store.replay()
+        assert replayed.to_bytes() == m.to_bytes()
+        assert [h.epoch for h in hist] == [8, 9, 10]
+
+    def test_pool_id_floor_survives_trim(self, tmp_path):
+        from ceph_tpu.cluster import Monitor
+
+        path = str(tmp_path / "mon" / "store.log")
+        store = MonStore(path, keep=2)
+        m = OSDMap()
+        # pool 5 created in ancient history, then (conceptually) deleted
+        for i, pools in [(1, (5,)), (2, ()), (3, ()), (4, ()), (5, ())]:
+            incr = self.mkincr(i, pools)
+            store.append(incr)
+            m = m.apply(incr)
+        m = m.apply(Incremental(epoch=6, removed_pools=("p5",)))
+        store.append(Incremental(epoch=6, removed_pools=("p5",)))
+        store.trim(m)
+        assert store.pool_id_floor() >= 5
+        replayed, hist = store.replay()
+        mon = Monitor(
+            initial=replayed, history=hist,
+            pool_id_floor=store.pool_id_floor(),
+        )
+        assert mon._next_pool_id > 5  # the dead pool's id is burned
