@@ -58,6 +58,7 @@ class MonStore:
         os.makedirs(root, exist_ok=True)
         self._kvdb = KeyValueDB(root, name="monstore")
         self._import_legacy()
+        self._n_incr = sum(1 for _ in self._kvdb.iterate(PREFIX_INCR))
 
     def _import_legacy(self) -> None:
         if not os.path.exists(self.path):
@@ -83,6 +84,14 @@ class MonStore:
         txn = self._kvdb.transaction()
         txn.set(PREFIX_INCR, _ekey(incr.epoch), incr.to_bytes())
         self._kvdb.submit_transaction(txn)
+        self._n_incr += 1
+        # the bounded window must hold in a LONG-LIVED process, not
+        # just across restarts: auto-trim once the rows reach twice
+        # the keep target (replaying to get the current map is cheap
+        # at this frequency)
+        if self._n_incr >= 2 * self.keep:
+            current, _ = self.replay()
+            self.trim(current)
 
     def trim(self, current: OSDMap) -> int:
         """Snapshot ``current`` and drop incrementals older than the
@@ -104,11 +113,11 @@ class MonStore:
                 max_pool = max(max_pool, pool.pool_id)
         txn = self._kvdb.transaction()
         txn.set(PREFIX_FULL, "full", current.to_bytes())
-        txn.set(PREFIX_FULL, "epoch", str(current.epoch).encode())
         txn.set(PREFIX_FULL, "max_pool_id", str(max_pool).encode())
         for k in doomed:
             txn.rmkey(PREFIX_INCR, k)
         self._kvdb.submit_transaction(txn)
+        self._n_incr -= len(doomed)
         return len(doomed)
 
     def pool_id_floor(self) -> int:

@@ -118,3 +118,94 @@ def test_thrash_kill_revive_under_io(seed):
     client.shutdown()
     for d in daemons.values():
         d.stop()
+
+
+@pytest.mark.parametrize("seed", [7702])
+def test_thrash_with_divergent_tampering(seed):
+    """Thrash + divergence: while a member is down, its store gets
+    'locally applied' writes nobody committed (garbage bytes with
+    bumped eversion stamps — the partitioned ex-primary residue). The
+    catch-up divergence scan must roll those back on revive; the model
+    stays bit-exact and the final scrub sweep is clean."""
+    from ceph_tpu.pipeline.rmw import OI_KEY, pack_oi, parse_oi
+    from ceph_tpu.store import Transaction
+
+    rng = np.random.default_rng(seed)
+    mon = Monitor()
+    daemons: dict[int, OSDDaemon] = {}
+    stores: dict[int, object] = {}
+    for i in range(N_OSD):
+        mon.osd_crush_add(i)
+    for i in range(N_OSD):
+        d = OSDDaemon(i, mon, chunk_size=1024, tick_period=0.2)
+        d.start()
+        daemons[i] = d
+        stores[i] = d.store
+    mon.osd_erasure_code_profile_set(
+        "rs32d", {"plugin": "isa", "k": str(K), "m": str(M)}
+    )
+    mon.osd_pool_create("ecpool", 4, "rs32d")
+    client = RadosClient(mon, backoff=0.02)
+    io = client.open_ioctx("ecpool")
+
+    model: dict[str, bytes] = {}
+    for i in range(8):
+        blob = rng.integers(0, 256, 4_000 + 137 * i, np.uint8).tobytes()
+        io.write(f"o{i}", blob)
+        model[f"o{i}"] = blob
+
+    def tamper(store) -> int:
+        """Divergent residue on a down member's store."""
+        n = 0
+        for key in store.list_objects():
+            if "#s" not in key or rng.random() > 0.6:
+                continue
+            size = store.stat(key)
+            if not size:
+                continue
+            try:
+                osize, ev = parse_oi(store.getattr(key, OI_KEY))
+            except (FileNotFoundError, KeyError, ValueError):
+                continue
+            store.queue_transactions(
+                Transaction()
+                .write(key, 0, bytes([0x99]) * min(size, 512))
+                .setattr(key, OI_KEY, pack_oi(osize, (ev[0], ev[1] + 500)))
+            )
+            n += 1
+        return n
+
+    total_tampered = 0
+    for round_no in range(3):
+        live = [i for i in daemons if daemons[i] is not None]
+        victim = int(rng.choice(live))
+        # down WITHOUT stopping: the store stays, gets tampered
+        mon.osd_down(victim)
+        total_tampered += tamper(stores[victim])
+        # IO continues degraded; some objects move past the victim
+        for i in range(4):
+            oid = f"r{round_no}_{i}"
+            blob = rng.integers(0, 256, 3_000, np.uint8).tobytes()
+            io.write(oid, blob)
+            model[oid] = blob
+        mon.osd_boot(victim, daemons[victim].addr)  # divergence scan runs
+        import time
+
+        time.sleep(0.3)  # let catch-up threads finish
+        for oid, blob in sorted(model.items()):
+            assert io.read(oid) == blob, f"stale/divergent read of {oid}"
+
+    assert total_tampered > 0, (
+        "tampering never happened: the test degraded to plain thrash"
+    )
+    for d in daemons.values():
+        d.scrub_all(repair=True)
+    for oid, blob in sorted(model.items()):
+        assert io.read(oid) == blob
+    for d in daemons.values():
+        for _pg, results in d.scrub_all().items():
+            for r in results:
+                assert r.ok, f"{r.oid}: {r.errors}"
+    client.shutdown()
+    for d in daemons.values():
+        d.stop()
