@@ -7,8 +7,9 @@ analog), matching the reference's layout discipline:
 
 - prefix ``I``: zero-padded epoch -> serialized ``Incremental`` (the
   paxos version rows);
-- prefix ``F``: ``full`` -> latest full-map snapshot, ``epoch`` -> its
-  epoch (the osdmap full_NNN row role).
+- prefix ``F``: ``full`` -> latest full-map snapshot (the osdmap
+  full_NNN row role; its epoch is decoded from the map itself) and
+  ``max_pool_id`` -> the trimmed-history pool-id floor.
 
 ``trim`` keeps a bounded incremental window: it snapshots the current
 full map and deletes incrementals below the floor — the mon's paxos

@@ -24,6 +24,7 @@ from .compressor import (
     maybe_compress,
     registry,
 )
+from . import tpu_offload  # noqa: F401  (registers tpu_* plugins)
 
 __all__ = [
     "CompressionMode",

@@ -1,0 +1,128 @@
+"""Accelerator-offloaded compressor — the QAT/UADK plugin role.
+
+The reference ships hardware-offload compression plugins
+(compressor/QatAccel.{h,cc}, UADK) behind the same registry as the
+software codecs — SURVEY.md §2.4 calls it "the in-tree precedent for
+'accelerator-offloaded codec plugin'". The TPU-native equivalent
+offloads the stage an accelerator is actually good at: the batched
+zero-block scan. Storage blobs are full of zero pages (sparse writes,
+truncate tails, the EC zero-padding convention — the codec flags
+ZERO_IN_ZERO_OUT / ZERO_PADDING_EXPECTED exist for the same reason),
+and finding them is a bandwidth-bound reduction the device does at
+HBM speed while the host would crawl byte-wise.
+
+``TpuZeroElimCompressor``: split into fixed blocks, device-reduce an
+any-nonzero mask per block (one dispatch for the whole buffer), emit
+``u32 orig_len | bitmap | nonzero blocks``. Optionally the surviving
+blocks go through zlib (``tpu_zlib`` — scan offloaded, entropy stage
+host-side, exactly the QAT split). Small buffers skip the device (the
+same dispatch-threshold discipline as the EC host fast path).
+
+Decompression is pure host reassembly — scatter of stored blocks into
+a zero canvas (cheap, and reads must not require an accelerator).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+from .compressor import Compressor, registry
+
+BLOCK = 256
+_HDR = struct.Struct("<IB")  # original length, flags
+_FLAG_ZLIB = 0x01
+
+#: below this, the mask computes on host (device dispatch latency
+#: dominates tiny buffers — the ec_host_dispatch_bytes discipline)
+DEVICE_THRESHOLD = 1 << 20
+
+
+def _nonzero_mask(blocks: np.ndarray) -> np.ndarray:
+    """[B, BLOCK] -> [B] bool, device-reduced when the buffer is big
+    enough and a device is initialized."""
+    if blocks.nbytes >= DEVICE_THRESHOLD:
+        try:
+            import jax.numpy as jnp
+
+            return np.asarray(jnp.any(jnp.asarray(blocks) != 0, axis=1))
+        except Exception:
+            pass  # device trouble: the host answer is identical
+    return blocks.any(axis=1)
+
+
+class TpuZeroElimCompressor(Compressor):
+    """Zero-block elimination with a device-offloaded scan."""
+
+    name = "tpu_zeroelim"
+    _zlib_residue = False
+
+    def _compress(self, data: bytes) -> tuple[bytes, int | None]:
+        orig_len = len(data)
+        arr = np.frombuffer(data, np.uint8)
+        aligned = (orig_len // BLOCK) * BLOCK
+        # zero-copy view of the aligned prefix; only the ragged tail
+        # (< BLOCK bytes) is copy-padded — a full-buffer concatenate
+        # would double host traffic in a bandwidth-purposed path
+        blocks = arr[:aligned].reshape(-1, BLOCK)
+        mask = _nonzero_mask(blocks)
+        parts = [blocks[mask]]
+        if aligned != orig_len:
+            tail = np.zeros(BLOCK, np.uint8)
+            tail[: orig_len - aligned] = arr[aligned:]
+            tail_nz = bool(tail.any())
+            mask = np.concatenate([mask, np.array([tail_nz])])
+            if tail_nz:
+                parts.append(tail[None, :])
+        residue = np.concatenate(parts).tobytes() if parts else b""
+        flags = 0
+        if self._zlib_residue:
+            flags |= _FLAG_ZLIB
+            residue = zlib.compress(residue, 5)
+        out = bytearray(_HDR.pack(orig_len, flags))
+        out += np.packbits(mask).tobytes()
+        out += residue
+        return bytes(out), None
+
+    def _decompress(self, data: bytes, msg: int | None) -> bytes:
+        # every corruption surfaces as ValueError — the contract the
+        # whole compressor family honors
+        if len(data) < _HDR.size:
+            raise ValueError("zeroelim blob shorter than its header")
+        orig_len, flags = _HDR.unpack_from(data, 0)
+        nblocks = -(-orig_len // BLOCK)
+        bitmap_bytes = -(-nblocks // 8)
+        pos = _HDR.size
+        if len(data) < pos + bitmap_bytes:
+            raise ValueError("zeroelim blob truncated in bitmap")
+        mask = np.unpackbits(
+            np.frombuffer(data, np.uint8, bitmap_bytes, pos)
+        )[:nblocks].astype(bool)
+        pos += bitmap_bytes
+        residue = data[pos:]
+        if flags & _FLAG_ZLIB:
+            try:
+                residue = zlib.decompress(residue)
+            except zlib.error as e:
+                raise ValueError(f"corrupt zlib residue: {e}") from e
+        stored = np.frombuffer(residue, np.uint8)
+        if stored.size != int(mask.sum()) * BLOCK:
+            raise ValueError("zeroelim residue length mismatch")
+        canvas = np.zeros((nblocks, BLOCK), np.uint8)
+        canvas[mask] = stored.reshape(-1, BLOCK)
+        return canvas.reshape(-1)[:orig_len].tobytes()
+
+
+class TpuZlibCompressor(TpuZeroElimCompressor):
+    """Device scan + host zlib on the surviving blocks — the QAT
+    split: offload the bandwidth stage, keep entropy coding where it
+    is cheap."""
+
+    name = "tpu_zlib"
+    _zlib_residue = True
+
+
+registry.register("tpu_zeroelim", TpuZeroElimCompressor)
+registry.register("tpu_zlib", TpuZlibCompressor)

@@ -77,3 +77,71 @@ class TestRequiredRatio:
             comp, b"A" * 1000, mode=CompressionMode.NONE
         )
         assert not compressed and blob == b"A" * 1000
+
+
+class TestTpuOffload:
+    """Accelerator-offloaded codec (the QAT plugin role): zero-block
+    elimination with a device-reduced scan, optional zlib residue."""
+
+    def test_round_trip_mixed_content(self, rng):
+        from ceph_tpu.compressor import registry
+
+        for name in ("tpu_zeroelim", "tpu_zlib"):
+            comp = registry.create(name)
+            # sparse blob: zero pages interleaved with random pages
+            parts = []
+            for i in range(40):
+                if i % 3:
+                    parts.append(b"\0" * 512)
+                else:
+                    parts.append(
+                        rng.integers(0, 256, 512, np.uint8).tobytes()
+                    )
+            blob = b"".join(parts) + b"tail"  # ragged on purpose
+            packed, msg = comp.compress(blob)
+            assert len(packed) < len(blob)  # zeros eliminated
+            assert comp.decompress(packed, msg) == blob
+
+    def test_all_zero_and_all_random(self, rng):
+        from ceph_tpu.compressor import registry
+
+        comp = registry.create("tpu_zeroelim")
+        zeros = b"\0" * 100_000
+        packed, msg = comp.compress(zeros)
+        assert len(packed) < 200  # header + bitmap only
+        assert comp.decompress(packed, msg) == zeros
+        noise = rng.integers(0, 256, 10_000, np.uint8).tobytes()
+        packed, msg = comp.compress(noise)
+        assert comp.decompress(packed, msg) == noise  # expands, still exact
+
+    def test_required_ratio_gate_rejects_incompressible(self, rng):
+        from ceph_tpu.compressor import maybe_compress, registry
+
+        comp = registry.create("tpu_zeroelim")
+        noise = rng.integers(0, 256, 8_192, np.uint8).tobytes()
+        blob, compressed, _msg = maybe_compress(
+            comp, noise, required_ratio=0.9
+        )
+        assert not compressed and blob == noise
+
+    def test_device_and_host_masks_agree(self, rng):
+        from ceph_tpu.compressor import tpu_offload
+
+        blocks = rng.integers(0, 2, (8192, tpu_offload.BLOCK), np.uint8)
+        blocks[::4] = 0
+        host = blocks.any(axis=1)
+        # force the device path regardless of size threshold
+        old = tpu_offload.DEVICE_THRESHOLD
+        tpu_offload.DEVICE_THRESHOLD = 0
+        try:
+            dev = tpu_offload._nonzero_mask(blocks)
+        finally:
+            tpu_offload.DEVICE_THRESHOLD = old
+        np.testing.assert_array_equal(host, dev)
+
+    def test_empty_input(self):
+        from ceph_tpu.compressor import registry
+
+        comp = registry.create("tpu_zeroelim")
+        packed, msg = comp.compress(b"")
+        assert comp.decompress(packed, msg) == b""
