@@ -100,14 +100,21 @@ def _as_blocks(
     if isinstance(data, (bytes, bytearray, memoryview)):
         buf = np.frombuffer(data, dtype=np.uint8)
     elif isinstance(data, np.ndarray):
-        buf = np.asarray(data, dtype=np.uint8).reshape(-1)
+        # Reinterpret the underlying BYTES (never value-cast): a csum
+        # covers the wire/disk representation, not truncated values.
+        buf = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
     else:
         # Device (jax) array: keep it resident — blocks feed the device
         # kernels without a host round trip (a BlueStore blob already
         # in HBM verifies in place; only the tiny csum array returns).
-        # Coerce to uint8 like the host branches, so size counts BYTES.
+        # Same bytes-not-values rule as the host branch: bitcast wider
+        # dtypes to their little-endian byte representation.
         if str(data.dtype) != "uint8":
-            data = data.astype("uint8")
+            import jax
+
+            data = jax.lax.bitcast_convert_type(
+                data.reshape(-1), np.uint8
+            )
         buf = data.reshape(-1)
     if buf.size % csum_block_size:
         raise ValueError(

@@ -125,8 +125,18 @@ def cmd_vstart(cl: Cluster, args) -> int:
         import secrets as _secrets
 
         # hex, not raw bytes: the file is read with a whitespace
-        # strip, which must never change the effective key
-        with open(os.path.join(cl.root, "keyring"), "w") as f:
+        # strip, which must never change the effective key.  0o600:
+        # the PSK must not be world-readable on multi-user hosts
+        # (ceph treats keyring files the same way).
+        fd = os.open(
+            os.path.join(cl.root, "keyring"),
+            os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+            0o600,
+        )
+        # O_CREAT's mode only applies to fresh inodes; a pre-existing
+        # (e.g. empty) keyring keeps its old perms without this.
+        os.fchmod(fd, 0o600)
+        with os.fdopen(fd, "w") as f:
             f.write(_secrets.token_hex(32) + "\n")
         print("keyring written: cluster runs AES-GCM secure mode from "
               "the next invocation")

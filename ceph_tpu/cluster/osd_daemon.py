@@ -765,26 +765,44 @@ class OSDDaemon:
             pg, oid
         )
 
+    def _authoritative_record(
+        self, pg: _PG, oid: str
+    ) -> "tuple[str, tuple[int, int] | None]":
+        """Three-way authority lookup: ``("ev", (epoch, tid))`` when
+        the latest committed write's stamp is known, ``("absent",
+        None)`` when the primary AFFIRMATIVELY has no record of the
+        object (its shard store is readable and the object is not
+        there), ``("unknown", None)`` when the authority could not be
+        judged — primary holds no shard of the object, the OI attr is
+        missing/corrupt, or only a pre-eversion stamp exists.  The
+        distinction matters for divergence handling: "absent" licenses
+        deleting a returning member's copy; "unknown" must not (the
+        primary's own incomplete local state would otherwise destroy a
+        committed shard)."""
+        ev = pg.rmw.object_eversion(oid)
+        if ev is not None:
+            return ("ev", ev)
+        ev = pg.pglog.last_eversion(oid)
+        if ev is not None and ev != (0, 0):
+            return ("ev", ev)
+        key = self._my_key(pg, oid)
+        if key is None:
+            return ("unknown", None)
+        try:
+            _size, ev = parse_oi(self.store.getattr(key, OI_KEY))
+        except FileNotFoundError:
+            return ("absent", None)
+        except (KeyError, ValueError):
+            return ("unknown", None)
+        return ("unknown", None) if ev == (0, 0) else ("ev", ev)
+
     def _authoritative_eversion(
         self, pg: _PG, oid: str
     ) -> "tuple[int, int] | None":
         """The (epoch, tid) the object's latest committed write
         stamped, from the live pipeline or my own shard's OI attr —
         the eversion_t comparison source (osd_types.h)."""
-        ev = pg.rmw.object_eversion(oid)
-        if ev is not None:
-            return ev
-        ev = pg.pglog.last_eversion(oid)
-        if ev is not None and ev != (0, 0):
-            return ev
-        key = self._my_key(pg, oid)
-        if key is None:
-            return None
-        try:
-            _size, ev = parse_oi(self.store.getattr(key, OI_KEY))
-        except (FileNotFoundError, KeyError, ValueError):
-            return None
-        return None if ev == (0, 0) else ev
+        return self._authoritative_record(pg, oid)[1]
 
     def _member_listing(self, pg: _PG, shard: int) -> list:
         """The returning member's PG listing WITH its pristine
@@ -821,10 +839,15 @@ class OSDDaemon:
             member_ev = tuple(ev) if len(ev) == 2 else (0, 0)
             if member_ev == (0, 0):
                 continue  # pre-eversion stamp: nothing to judge
-            auth = self._authoritative_eversion(pg, loc)
-            if auth is None:
+            kind, auth = self._authoritative_record(pg, loc)
+            if kind == "absent":
+                # Primary affirmatively never heard of it: a divergent
+                # create — remove before it can pollute EC decodes.
                 delete.add(loc)
-            elif member_ev != auth:
+            elif kind == "unknown" or member_ev != auth:
+                # Unjudgeable authority (primary's own attr unreadable
+                # or pre-eversion) degrades to rollback — rebuilding
+                # from survivors is safe either way; deletion is not.
                 rollback.add(loc)
         return rollback, delete
 

@@ -19,7 +19,7 @@ from collections.abc import Callable
 
 from . import secure as secure_mod
 from .messages import decode_message, message_type
-from .wire import decode_frame, encode_frame
+from .wire import BadFrame, decode_frame, encode_frame
 
 # In-the-clear handshake frame type for secure-mode nonce exchange
 # (outside the normal message-type space; auth_none + CephX roles).
@@ -71,14 +71,20 @@ class Connection:
     def _do_handshake(self, is_client: bool) -> None:
         my_nonce = secure_mod.fresh_nonce()
         hello = encode_frame(HANDSHAKE_TYPE, 0, [my_nonce])
-        if is_client:
-            self.sock.sendall(hello)
-            peer_nonce = self._read_handshake()
-            nonce_c, nonce_s = my_nonce, peer_nonce
-        else:
-            peer_nonce = self._read_handshake()
-            self.sock.sendall(hello)
-            nonce_c, nonce_s = peer_nonce, my_nonce
+        try:
+            if is_client:
+                self.sock.sendall(hello)
+                peer_nonce = self._read_handshake()
+                nonce_c, nonce_s = my_nonce, peer_nonce
+            else:
+                peer_nonce = self._read_handshake()
+                self.sock.sendall(hello)
+                nonce_c, nonce_s = peer_nonce, my_nonce
+        except (EOFError, BadFrame, socket.timeout) as e:
+            # A clear-mode or garbage-speaking peer must look like any
+            # other dead link (callers map ConnectionError to a down
+            # shard), not raise EOFError/BadFrame out of the op path.
+            raise ConnectionError(f"secure handshake failed: {e!r}") from e
         self._tx, self._rx = secure_mod.derive_session(
             self.messenger.secret, nonce_c, nonce_s, is_client
         )
