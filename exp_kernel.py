@@ -1,0 +1,892 @@
+"""Kernel roofline experiments (round 3, not part of the package).
+
+Measures, with bench.py's on-device-loop + trip-count-differencing
+methodology:
+  copy   — DMA-only probe (same grid/blockspecs, out = xor of rows):
+           the achievable ceiling for this traffic pattern
+  cur    — the shipping kernel (ops/pallas_encode.py)
+  v3     — packed-int32 unpack (bitcast, (x>>b)&0x01010101) + plane
+           matmul + matmul-based byte pack (W weights 2^b, -128 for b7)
+
+Usage: PYTHONPATH=/root/repo python exp_kernel.py [variants...]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
+from ceph_tpu.ops import pallas_encode as pe
+from ceph_tpu.ops.bitplane import gf_encode_bitplane
+
+K, M = 8, 4
+CHUNK = 1 << 20
+BATCH = 8
+N1, N2 = 10, 110
+REPS = 5
+
+
+def _timed(fn, *args) -> float:
+    t0 = time.perf_counter()
+    np.asarray(fn(*args))
+    return time.perf_counter() - t0
+
+
+def _per_iter(fn, *args) -> float:
+    diffs = []
+    for _ in range(REPS):
+        d = (_timed(fn, *args, N2) - _timed(fn, *args, N1)) / (N2 - N1)
+        if d > 0:
+            diffs.append(d)
+    return float(np.median(diffs)) if diffs else float("nan")
+
+
+def _loop(apply, out_shards):
+    @jax.jit
+    def loop(data, iters):
+        def body(i, carry):
+            d, acc = carry
+            d = jnp.bitwise_xor(d, jnp.uint8(i + 1))
+            return d, jnp.bitwise_xor(acc, apply(d))
+
+        _, acc = jax.lax.fori_loop(
+            0, iters, body,
+            (data, jnp.zeros((BATCH, out_shards, CHUNK), jnp.uint8)),
+        )
+        return acc[0, 0, 0]
+
+    return loop
+
+
+@jax.jit
+def _loop_perturb(data, iters):
+    def body(i, carry):
+        d, acc = carry
+        d = jnp.bitwise_xor(d, jnp.uint8(i + 1))
+        return d, jnp.bitwise_xor(acc, d[:, :M, :])
+
+    _, acc = jax.lax.fori_loop(
+        0, iters, body,
+        (data, jnp.zeros((BATCH, M, CHUNK), jnp.uint8)),
+    )
+    return acc[0, 0, 0]
+
+
+# ---------------------------------------------------------------- copy probe
+def _copy_kernel(data_ref, out_ref):
+    d = data_ref[0]
+    out_ref[0] = d[0:M] ^ d[M : 2 * M]
+
+
+@functools.partial(jax.jit, static_argnames=("lane_tile",))
+def copy_probe(data, lane_tile):
+    b, k, n = data.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(b, n // lane_tile),
+        in_specs=[pl.BlockSpec((1, k, lane_tile), lambda b, c: (b, 0, c))],
+        out_specs=pl.BlockSpec((1, M, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, M, n), jnp.uint8),
+    )(data)
+
+
+# ------------------------------------------------------------------ v3 kernel
+def _pack_weights(m: int) -> np.ndarray:
+    """W[j, b*m+j] = 2^b as int8 (-128 stands for 128; the final
+    int32->uint8 convert wraps mod 256, recovering the true byte)."""
+    w = np.zeros((m, 8 * m), np.int8)
+    for b in range(8):
+        for j in range(m):
+            w[j, b * m + j] = (1 << b) if b < 7 else -128
+    return w
+
+
+def _make_v3_kernel(k: int, m: int):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, wmat_ref, data_ref, out_ref):
+        d = data_ref[0]  # [K, T] uint8
+        # Sublane bitcast: 4 uint8 rows pack into one int32 row. The
+        # shift+mask keeps each byte's bit in its own byte lane, and
+        # the bitcast back scatters byte lanes to the sublanes they
+        # came from — row order is self-consistent either way.
+        xi = pltpu.bitcast(d, jnp.int32)  # [K/4, T]
+        planes = []
+        for b in range(8):
+            pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+            planes.append(pltpu.bitcast(pb, jnp.int8))  # [K, T] plane b
+        bits = jnp.concatenate(planes, axis=0)  # [8K, T] plane-major
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [8M, T]
+        acc8 = acc.astype(jnp.int8) & jnp.int8(1)
+        packed = jax.lax.dot_general(
+            wmat_ref[:], acc8,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [M, T]
+        out_ref[0] = packed.astype(jnp.uint8)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "lane_tile"))
+def v3_encode(bmat_pm, wmat, data, k, m, lane_tile):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v3_kernel(k, m),
+        grid=(b, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat_pm.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec(wmat.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec((1, k, lane_tile), lambda b, c: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((1, m, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmat_pm, wmat, data)
+
+
+# ---------------------------------------------------- v4: multi-stripe tiles
+# Pack S=8 stripes per block so every intermediate fills native vreg
+# tiles: d [S*K=64, T'] uint8 (2 int8 tiles), xi [16, T'] int32 (2
+# tiles), bits [512, T'], acc [256, T'] — no partial-tile waste. The
+# matmul is block-diagonal over stripes (host-built sparse matrix).
+
+S = 8  # stripes per block
+
+
+def _vS_matrices(bmat_np: np.ndarray, k: int, m: int, s_count: int):
+    """Generalized block-diag matrices for s_count stripes.
+    bits row (s, b, i) = s*8*k + b*k + i  (stripe-major blocks so the
+    contraction splits cleanly at 128); acc row (s, b', j); out row
+    (s, j)."""
+    bb = np.zeros((8 * s_count * m, 8 * s_count * k), np.int8)
+    for s in range(s_count):
+        for bp in range(8):
+            for b in range(8):
+                for j in range(m):
+                    for i in range(k):
+                        bb[
+                            s * 8 * m + bp * m + j,
+                            s * 8 * k + b * k + i,
+                        ] = bmat_np[j * 8 + bp, i * 8 + b]
+    wb = np.zeros((s_count * m, 8 * s_count * m), np.int8)
+    for s in range(s_count):
+        for bp in range(8):
+            v = (1 << bp) if bp < 7 else -128
+            for j in range(m):
+                wb[s * m + j, s * 8 * m + bp * m + j] = v
+    return bb, wb
+
+
+def _make_v5_kernel(k: int, m: int, s_count: int):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, wmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [S2, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(s_count * k, t)  # row s*k+i
+        xi = pltpu.bitcast(flat, jnp.int32)  # [S2*k/4, T']
+        planes = []
+        for b in range(8):
+            pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+            planes.append(pltpu.bitcast(pb, jnp.int8))  # [S2*k, T']
+        # bits row (s, b, i): stack planes then interleave stripes to
+        # stripe-major via reshape/transpose-free indexing: build by
+        # slicing each plane's stripe rows.
+        per_stripe = []
+        for s in range(s_count):
+            for b in range(8):
+                per_stripe.append(planes[b][s * k : (s + 1) * k])
+        bits = jnp.concatenate(per_stripe, axis=0)  # [8*S2*k, T']
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [8*S2*m, T']
+        acc8 = acc.astype(jnp.int8) & jnp.int8(1)
+        packed = jax.lax.dot_general(
+            wmat_ref[:], acc8,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [S2*m, T']
+        out_ref[:] = packed.astype(jnp.uint8).reshape(s_count, m, t)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "lane_tile", "s_count")
+)
+def v5_encode(bmat_big, wmat_big, data, k, m, lane_tile, s_count=2):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v5_kernel(k, m, s_count),
+        grid=(b // s_count, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat_big.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec(wmat_big.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec(
+                (s_count, k, lane_tile), lambda b, c: (b, 0, c)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (s_count, m, lane_tile), lambda b, c: (b, 0, c)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmat_big, wmat_big, data)
+
+
+# ------------------- v6: plane-major columns (no slice-interleave concat)
+def _v6_matrices(bmat_np: np.ndarray, k: int, m: int, s_count: int):
+    """Column order (b, s, i) = concat(planes) order — the stripe
+    interleave lives in the matrix, not the data. Rows (s, b', j) so
+    the pack matmul stays block-diag per stripe."""
+    bb = np.zeros((8 * s_count * m, 8 * s_count * k), np.int8)
+    for s in range(s_count):
+        for bp in range(8):
+            for b in range(8):
+                for j in range(m):
+                    for i in range(k):
+                        bb[
+                            s * 8 * m + bp * m + j,
+                            b * s_count * k + s * k + i,
+                        ] = bmat_np[j * 8 + bp, i * 8 + b]
+    wb = np.zeros((s_count * m, 8 * s_count * m), np.int8)
+    for s in range(s_count):
+        for bp in range(8):
+            v = (1 << bp) if bp < 7 else -128
+            for j in range(m):
+                wb[s * m + j, s * 8 * m + bp * m + j] = v
+    return bb, wb
+
+
+def _make_v6_kernel(k: int, m: int, s_count: int, ablate: str = ""):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, wmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [S, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(s_count * k, t)
+        xi = pltpu.bitcast(flat, jnp.int32)
+        planes = []
+        for b in range(8):
+            pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+            planes.append(pltpu.bitcast(pb, jnp.int8))  # [S*k, T']
+        if ablate == "planes":
+            o = planes[0]
+            for b in range(1, 8):
+                o = o ^ planes[b]
+            out_ref[:] = (
+                o[: s_count * m, :].astype(jnp.uint8).reshape(s_count, m, t)
+            )
+            return
+        bits = jnp.concatenate(planes, axis=0)  # [8*S*k, T'] (b,s,i)
+        if ablate == "bits":
+            o = bits[: s_count * m] ^ bits[64 : 64 + s_count * m]
+            out_ref[:] = o.astype(jnp.uint8).reshape(s_count, m, t)
+            return
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [8*S*m, T']
+        if ablate == "mm":
+            out_ref[:] = (
+                acc[: s_count * m].astype(jnp.uint8).reshape(s_count, m, t)
+            )
+            return
+        acc8 = acc.astype(jnp.int8) & jnp.int8(1)
+        packed = jax.lax.dot_general(
+            wmat_ref[:], acc8,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        out_ref[:] = packed.astype(jnp.uint8).reshape(s_count, m, t)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "lane_tile", "s_count", "ablate")
+)
+def v6_encode(bmat_big, wmat_big, data, k, m, lane_tile, s_count=2, ablate=""):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v6_kernel(k, m, s_count, ablate),
+        grid=(b // s_count, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat_big.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec(wmat_big.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec(
+                (s_count, k, lane_tile), lambda b, c: (b, 0, c)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (s_count, m, lane_tile), lambda b, c: (b, 0, c)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmat_big, wmat_big, data)
+
+
+# --- v9: v6 structure (S=2, one [64,128] matmul) + nibble-bitcast pack.
+def _v9_matrices(bmat_np: np.ndarray, k: int, m: int):
+    """bmat [64, 128] int8: acc row r = h*32 + s*16 + j*4 + b2 (b' =
+    h*4 + b2); col (b, s, i) = b*16 + s*8 + i (concat(planes) order,
+    S=2)."""
+    mat = np.zeros((64, 128), np.int8)
+    for h in range(2):
+        for s in range(2):
+            for j in range(m):
+                for b2 in range(4):
+                    bp = h * 4 + b2
+                    r = h * 32 + s * 16 + j * 4 + b2
+                    for b in range(8):
+                        for i in range(k):
+                            mat[r, b * 16 + s * 8 + i] = bmat_np[
+                                j * 8 + bp, i * 8 + b
+                            ]
+    return mat
+
+
+def _make_v9_kernel(k: int, m: int, i32concat: bool = False):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [2, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(2 * k, t)
+        xi = pltpu.bitcast(flat, jnp.int32)  # [4, T']
+        if i32concat:
+            p32 = [
+                (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+                for b in range(8)
+            ]
+            bits = pltpu.bitcast(
+                jnp.concatenate(p32, axis=0), jnp.int8
+            )  # [128, T'] (b, s, i)
+        else:
+            planes = []
+            for b in range(8):
+                pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+                planes.append(pltpu.bitcast(pb, jnp.int8))  # [16, T']
+            bits = jnp.concatenate(planes, axis=0)  # [128, T'] (b, s, i)
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [64, T'] rows (h, s, j, b2)
+        acc8 = acc.astype(jnp.int8)
+        p32 = pltpu.bitcast(acc8, jnp.int32)  # [16, T']
+        masked = p32 & jnp.int32(0x01010101)
+        nib = (
+            masked
+            | (masked >> jnp.int32(7))
+            | (masked >> jnp.int32(14))
+            | (masked >> jnp.int32(21))
+        ) & jnp.int32(0xF)
+        out32 = nib[0:8] | (nib[8:16] << jnp.int32(4))  # [8, T']
+        out_ref[:] = out32.astype(jnp.uint8).reshape(2, m, t)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "lane_tile", "i32concat", "dimsem")
+)
+def v9_encode(bmat, data, k, m, lane_tile, i32concat=False, dimsem=False):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, _, n = data.shape
+    params = {}
+    if dimsem:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    return pl.pallas_call(
+        _make_v9_kernel(k, m, i32concat),
+        grid=(b // 2, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec((2, k, lane_tile), lambda b, c: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((2, m, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+        **params,
+    )(bmat, data)
+
+
+# --- v12: v9 + single variable-shift unpack (no per-plane ops, no
+# --- int8 concat: the stacked int32 bitcast IS the (b,s,i) order).
+def _make_v12_kernel(k: int, m: int):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [2, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(2 * k, t)
+        xi = pltpu.bitcast(flat, jnp.int32)  # [4, T']
+        rows = 2 * k * 2  # 32
+        X = jnp.concatenate([xi] * 8, axis=0)  # [32, T'] b-major
+        shifts = jax.lax.broadcasted_iota(
+            jnp.int32, (rows, t), 0
+        ) >> jnp.int32(2)  # row r -> b = r // 4
+        pb = (X >> shifts) & jnp.int32(0x01010101)
+        bits = pltpu.bitcast(pb, jnp.int8)  # [128, T'] (b, s, i)
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [64, T']
+        acc8 = acc.astype(jnp.int8)
+        p32 = pltpu.bitcast(acc8, jnp.int32)
+        masked = p32 & jnp.int32(0x01010101)
+        nib = (
+            masked
+            | (masked >> jnp.int32(7))
+            | (masked >> jnp.int32(14))
+            | (masked >> jnp.int32(21))
+        ) & jnp.int32(0xF)
+        out32 = nib[0:8] | (nib[8:16] << jnp.int32(4))
+        out_ref[:] = out32.astype(jnp.uint8).reshape(2, m, t)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "lane_tile"))
+def v12_encode(bmat, data, k, m, lane_tile):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v12_kernel(k, m),
+        grid=(b // 2, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec((2, k, lane_tile), lambda b, c: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((2, m, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmat, data)
+
+
+# --- v11: S=4, one [128, 256] matmul (Mosaic splits contraction
+# --- internally, MXU accumulator sums), full-tile concat, nibble pack.
+def _v11_matrices(bmat_np: np.ndarray, k: int, m: int):
+    """[128, 256] int8. acc row r = h*64 + s*16 + j*4 + b2 (b' =
+    h*4+b2); col (b, s, i) = b*32 + s*8 + i."""
+    mat = np.zeros((128, 256), np.int8)
+    for h in range(2):
+        for s in range(4):
+            for j in range(m):
+                for b2 in range(4):
+                    bp = h * 4 + b2
+                    r = h * 64 + s * 16 + j * 4 + b2
+                    for b in range(8):
+                        for i in range(k):
+                            mat[r, b * 32 + s * 8 + i] = bmat_np[
+                                j * 8 + bp, i * 8 + b
+                            ]
+    return mat
+
+
+def _make_v11_kernel(k: int, m: int, pref8: bool = False):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [4, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(4 * k, t)        # [32, T'] full tile
+        xi = pltpu.bitcast(flat, jnp.int32)  # [8, T'] full tile
+        planes = []
+        for b in range(8):
+            pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+            planes.append(pltpu.bitcast(pb, jnp.int8))  # [32, T']
+        bits = jnp.concatenate(planes, axis=0)  # [256, T'] full tiles
+        if pref8:
+            acc8 = jax.lax.dot_general(
+                bmat_ref[:], bits,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int8,
+            )
+        else:
+            acc = jax.lax.dot_general(
+                bmat_ref[:], bits,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [128, T']
+            acc8 = acc.astype(jnp.int8)
+        p32 = pltpu.bitcast(acc8, jnp.int32)  # [32, T']
+        masked = p32 & jnp.int32(0x01010101)
+        nib = (
+            masked
+            | (masked >> jnp.int32(7))
+            | (masked >> jnp.int32(14))
+            | (masked >> jnp.int32(21))
+        ) & jnp.int32(0xF)
+        out32 = nib[0:16] | (nib[16:32] << jnp.int32(4))  # [16, T']
+        out_ref[:] = out32.astype(jnp.uint8).reshape(4, m, t)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "lane_tile", "pref8")
+)
+def v11_encode(bmat, data, k, m, lane_tile, pref8=False):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v11_kernel(k, m, pref8),
+        grid=(b // 4, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec((4, k, lane_tile), lambda b, c: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((4, m, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmat, data)
+
+
+# --- v8: S=4 full-tile unpack, two 128-contraction summed matmuls,
+# --- bitcast-nibble pack (no second MXU stream).
+def _v8_matrices(bmat_np: np.ndarray, k: int, m: int):
+    """Returns (bmatA, bmatB) [128, 128] int8. acc row r = h*64 +
+    s*16 + j*4 + b2 with output bit b' = h*4 + b2; bits col (within
+    half) c = bh*32 + s*8 + i where plane b = half*4 + bh."""
+    s_count = 4
+    mats = []
+    for half in range(2):
+        mat = np.zeros((128, 128), np.int8)
+        for h in range(2):
+            for s in range(s_count):
+                for j in range(m):
+                    for b2 in range(4):
+                        bp = h * 4 + b2
+                        r = h * 64 + s * 16 + j * 4 + b2
+                        for bh in range(4):
+                            b = half * 4 + bh
+                            for i in range(k):
+                                mat[r, bh * 32 + s * 8 + i] = bmat_np[
+                                    j * 8 + bp, i * 8 + b
+                                ]
+        mats.append(mat)
+    return mats[0], mats[1]
+
+
+def _make_v8_kernel(k: int, m: int):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmatA_ref, bmatB_ref, data_ref, out_ref):
+        d = data_ref[:]  # [4, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(4 * k, t)       # [32, T'] — one full int8 tile
+        xi = pltpu.bitcast(flat, jnp.int32)  # [8, T'] — full int32 tile
+        planes = []
+        for b in range(8):
+            pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+            planes.append(pltpu.bitcast(pb, jnp.int8))  # [32, T']
+        bits_lo = jnp.concatenate(planes[:4], axis=0)   # [128, T']
+        bits_hi = jnp.concatenate(planes[4:], axis=0)   # [128, T']
+        # Parity = (count_lo + count_hi) & 1 — the plane-half split
+        # sums before the mod-2, so two 128-contraction passes replace
+        # one 256-contraction (which Mosaic would split anyway, but
+        # with a second full stream of zeros).
+        acc = jax.lax.dot_general(
+            bmatA_ref[:], bits_lo,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ) + jax.lax.dot_general(
+            bmatB_ref[:], bits_hi,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [128, T'] rows (h, s, j, b2)
+        acc8 = acc.astype(jnp.int8)               # popcounts fit int8
+        p32 = pltpu.bitcast(acc8, jnp.int32)      # [32, T'] 4 rows/elt
+        masked = p32 & jnp.int32(0x01010101)
+        nib = (
+            masked
+            | (masked >> jnp.int32(7))
+            | (masked >> jnp.int32(14))
+            | (masked >> jnp.int32(21))
+        ) & jnp.int32(0xF)                        # [32, T'] nibbles
+        out32 = nib[0:16] | (nib[16:32] << jnp.int32(4))  # [16, T']
+        out_ref[:] = out32.astype(jnp.uint8).reshape(4, m, t)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "lane_tile"))
+def v8_encode(bmatA, bmatB, data, k, m, lane_tile):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v8_kernel(k, m),
+        grid=(b // 4, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec((128, 128), lambda b, c: (0, 0)),
+            pl.BlockSpec((128, 128), lambda b, c: (0, 0)),
+            pl.BlockSpec((4, k, lane_tile), lambda b, c: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((4, m, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmatA, bmatB, data)
+
+
+def _v4_matrices(bmat_np: np.ndarray, k: int, m: int):
+    """bits row (b, s, i) = b*S*k + s*k + i; acc row (b', s, j) =
+    b'*S*m + s*m + j; out row (s, j) = s*m + j."""
+    bb = np.zeros((8 * S * m, 8 * S * k), np.int8)
+    for bp in range(8):
+        for b in range(8):
+            for s in range(S):
+                for j in range(m):
+                    for i in range(k):
+                        bb[bp * S * m + s * m + j, b * S * k + s * k + i] = (
+                            bmat_np[j * 8 + bp, i * 8 + b]
+                        )
+    wb = np.zeros((S * m, 8 * S * m), np.int8)
+    for bp in range(8):
+        v = (1 << bp) if bp < 7 else -128
+        for s in range(S):
+            for j in range(m):
+                wb[s * m + j, bp * S * m + s * m + j] = v
+    return bb, wb
+
+
+def _make_v4_kernel(k: int, m: int, pack: str):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, wmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [S, K, T'] uint8
+        t = d.shape[2]
+        flat = d.reshape(S * k, t)  # row s*k+i
+        xi = pltpu.bitcast(flat, jnp.int32)  # [S*k/4, T']
+        planes = []
+        for b in range(8):
+            pb = (xi >> jnp.int32(b)) & jnp.int32(0x01010101)
+            planes.append(pltpu.bitcast(pb, jnp.int8))  # [S*k, T']
+        bits = jnp.concatenate(planes, axis=0)  # [8*S*k, T']
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [8*S*m, T']
+        if pack == "mm":
+            acc8 = acc.astype(jnp.int8) & jnp.int8(1)
+            packed = jax.lax.dot_general(
+                wmat_ref[:], acc8,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [S*m, T']
+        else:  # shift-or pack on full tiles
+            sm = S * m
+            packed = acc[0:sm] & jnp.int32(1)
+            for b in range(1, 8):
+                packed = packed | (
+                    (acc[b * sm : (b + 1) * sm] & jnp.int32(1))
+                    << jnp.int32(b)
+                )
+        out_ref[:] = packed.astype(jnp.uint8).reshape(S, m, t)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "lane_tile", "pack"))
+def v4_encode(bmat_big, wmat_big, data, k, m, lane_tile, pack="mm"):
+    b, _, n = data.shape
+    return pl.pallas_call(
+        _make_v4_kernel(k, m, pack),
+        grid=(b // S, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat_big.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec(wmat_big.shape, lambda b, c: (0, 0)),
+            pl.BlockSpec((S, k, lane_tile), lambda b, c: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((S, m, lane_tile), lambda b, c: (b, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+    )(bmat_big, wmat_big, data)
+
+
+def main() -> None:
+    variants = sys.argv[1:] or ["copy", "cur", "v9-65536", "v12-65536"]
+
+    g = vandermonde_rs_matrix(K, M)
+    bmat_np = gf_matrix_to_bitmatrix(g[K:, :])
+    bmat_pm = jnp.asarray(
+        pe._plane_major_bitmatrix(bmat_np, K, M).astype(np.int8)
+    )
+    wmat = jnp.asarray(_pack_weights(M))
+
+    bb_np, wb_np = _v4_matrices(bmat_np, K, M)
+    bmat_big4 = jnp.asarray(bb_np)
+    wmat_big4 = jnp.asarray(wb_np)
+
+    rng = np.random.default_rng(0)
+
+    # correctness first, small shape
+    small = jnp.asarray(rng.integers(0, 256, (8, K, 8192), np.uint8))
+    ref = np.asarray(gf_encode_bitplane(jnp.asarray(bmat_np), small))
+    for v in variants:
+        if v.startswith("v3"):
+            got = np.asarray(v3_encode(bmat_pm, wmat, small, K, M, 8192))
+        elif v.startswith("v12"):
+            b9 = _v9_matrices(bmat_np, K, M)
+            got = np.asarray(v12_encode(jnp.asarray(b9), small, K, M, 4096))
+        elif v.startswith("v11"):
+            b11 = _v11_matrices(bmat_np, K, M)
+            got = np.asarray(
+                v11_encode(
+                    jnp.asarray(b11), small, K, M, 4096, "p8" in v
+                )
+            )
+        elif v.startswith("v9"):
+            b9 = _v9_matrices(bmat_np, K, M)
+            got = np.asarray(
+                v9_encode(
+                    jnp.asarray(b9), small, K, M, 4096, "i32" in v,
+                    v.endswith("ds"),
+                )
+            )
+        elif v.startswith("v8"):
+            bA, bB = _v8_matrices(bmat_np, K, M)
+            got = np.asarray(
+                v8_encode(jnp.asarray(bA), jnp.asarray(bB), small, K, M, 4096)
+            )
+        elif v.startswith("v6") and "abl" not in v:
+            sc = int(v[2])
+            bb, wb = _v6_matrices(bmat_np, K, M, sc)
+            got = np.asarray(
+                v6_encode(
+                    jnp.asarray(bb), jnp.asarray(wb), small, K, M, 4096, sc
+                )
+            )
+        elif v.startswith("v5"):
+            sc = int(v[2])  # v5{s}-{tile}
+            bb, wb = _vS_matrices(bmat_np, K, M, sc)
+            got = np.asarray(
+                v5_encode(
+                    jnp.asarray(bb), jnp.asarray(wb), small, K, M, 4096, sc
+                )
+            )
+        elif v.startswith("v4"):
+            pack = "mm" if "mm" in v else "so"
+            got = np.asarray(
+                v4_encode(bmat_big4, wmat_big4, small, K, M, 4096, pack)
+            )
+        else:
+            continue
+        ok = np.array_equal(ref, got)
+        print(f"correctness {v}: {'OK' if ok else 'MISMATCH'}")
+        if not ok:
+            return
+
+    data = jnp.asarray(
+        rng.integers(0, 256, (BATCH, K, CHUNK)).astype(np.uint8)
+    )
+
+    applies = {}
+    for v in variants:
+        if v == "copy":
+            applies[v] = lambda d: copy_probe(d, 65536)
+        elif v == "cur":
+            # whatever ships in ops/pallas_encode right now
+            applies[v] = lambda d: pe.gf_encode_bitplane_pallas(bmat_np, d)
+        elif v.startswith("v3"):
+            t = int(v.split("-")[1])
+            applies[v] = (lambda t: lambda d: v3_encode(bmat_pm, wmat, d, K, M, t))(t)
+        elif v.startswith("v12"):
+            t = int(v.split("-")[1])
+            b9j = jnp.asarray(_v9_matrices(bmat_np, K, M))
+            applies[v] = (
+                lambda t, b9j: lambda d: v12_encode(b9j, d, K, M, t)
+            )(t, b9j)
+        elif v.startswith("v11"):
+            t = int(v.split("-")[1])
+            b11j = jnp.asarray(_v11_matrices(bmat_np, K, M))
+            applies[v] = (
+                lambda t, b11j, p8: lambda d: v11_encode(
+                    b11j, d, K, M, t, p8
+                )
+            )(t, b11j, "p8" in v)
+        elif v.startswith("v9"):
+            # v9-<tile>[-i32][-ds]
+            t = int(v.split("-")[1])
+            i32 = "i32" in v
+            ds = v.endswith("ds")
+            b9j = jnp.asarray(_v9_matrices(bmat_np, K, M))
+            applies[v] = (
+                lambda t, b9j, i32, ds: lambda d: v9_encode(
+                    b9j, d, K, M, t, i32, ds
+                )
+            )(t, b9j, i32, ds)
+        elif v.startswith("v8"):
+            t = int(v.split("-")[1])
+            bA, bB = _v8_matrices(bmat_np, K, M)
+            bAj, bBj = jnp.asarray(bA), jnp.asarray(bB)
+            applies[v] = (
+                lambda t, bAj, bBj: lambda d: v8_encode(bAj, bBj, d, K, M, t)
+            )(t, bAj, bBj)
+        elif v.startswith("v6"):
+            # name: v6{s}-{tile} or v6{s}-{tile}-abl{planes|bits|mm}
+            parts = v.split("-")
+            sc = int(v[2])
+            t = int(parts[1])
+            abl = parts[2][3:] if len(parts) > 2 else ""
+            bb, wb = _v6_matrices(bmat_np, K, M, sc)
+            bbj, wbj = jnp.asarray(bb), jnp.asarray(wb)
+            applies[v] = (
+                lambda t, sc, bbj, wbj, abl: lambda d: v6_encode(
+                    bbj, wbj, d, K, M, t, sc, abl
+                )
+            )(t, sc, bbj, wbj, abl)
+        elif v.startswith("v5"):
+            # name: v5{s}-{tile}
+            sc = int(v[2])
+            t = int(v.split("-")[1])
+            bb, wb = _vS_matrices(bmat_np, K, M, sc)
+            bbj, wbj = jnp.asarray(bb), jnp.asarray(wb)
+            applies[v] = (
+                lambda t, sc, bbj, wbj: lambda d: v5_encode(
+                    bbj, wbj, d, K, M, t, sc
+                )
+            )(t, sc, bbj, wbj)
+        elif v.startswith("v4"):
+            # name: v4mm-4096 / v4so-4096
+            pack = "mm" if "mm" in v else "so"
+            t = int(v.split("-")[1])
+            applies[v] = (
+                lambda t, p: lambda d: v4_encode(
+                    bmat_big4, wmat_big4, d, K, M, t, p
+                )
+            )(t, pack)
+
+    for n in (N1, N2):
+        _timed(_loop_perturb, data, n)
+    pert = _per_iter(_loop_perturb, data)
+    print(f"perturb-only: {pert*1e3:.3f} ms/iter")
+
+    bytes_in = BATCH * K * CHUNK
+    for name, apply in applies.items():
+        try:
+            loop = _loop(apply, M)
+            for n in (N1, N2):
+                _timed(loop, data, n)
+            dt = max(_per_iter(loop, data) - pert, 1e-9)
+            gbps = bytes_in / dt / 1e9
+            traffic = gbps * (K + M) / K
+            print(
+                f"{name:10s}: {gbps:7.1f} GB/s data-in   "
+                f"traffic {traffic:7.1f} GB/s  ({traffic/819:.0%} roofline)"
+            )
+        except Exception as e:
+            print(f"{name:10s}: FAILED {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()
