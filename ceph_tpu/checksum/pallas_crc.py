@@ -8,17 +8,20 @@ unpack bits in registers, one int8 MXU matmul per tile, never write
 bits to memory — HBM traffic is the data itself plus a [B, 32] int32
 accumulator.
 
-Shape: blocks ride the sublane axis, bit-columns the lane axis:
+Shape: blocks ride the sublane axis; the shared packed-int32 unpack
+(ops/pallas_encode.unpack_bitplanes) produces planes as ROWS
+(plane b, block), so the fold is 8 per-plane dots:
 
-    acc[bt, :] = Σ_sub  bits[bt, SUB*8] @ K_T[sub][SUB*8, 32]
+    acc[bt, :] = Σ_sub Σ_b  bits_b[bt, SUB] @ K_T[sub][b*SUB:(b+1)*SUB, 32]
 
 with the fold tensor K (checksum/crc32c.fold_tensor) transposed and
-permuted host-side to the kernel's plane-major bit order (lane j*8+b
-is laid out as plane b, byte j — sub-32-bit shifts don't exist on
-Mosaic, so planes are concatenated whole). Long blocks fold across a
-second grid axis that revisits the accumulator (read-modify-write on
-out_ref); parity (&1), the init-register contribution, and the 32-bit
-pack are a tiny [B, 32] epilogue outside the kernel.
+permuted host-side to plane-major row order (row b*SUB + j = bit b
+of byte j within the sub-block). Contraction per dot is SUB, not
+SUB*8 — a streamed MXU column carries 16 data bytes instead of 8.
+Long blocks fold across a second grid axis that revisits the
+accumulator (read-modify-write on out_ref); parity (&1), the
+init-register contribution, and the 32-bit pack are a tiny [B, 32]
+epilogue outside the kernel.
 """
 
 from __future__ import annotations
@@ -65,24 +68,45 @@ def _plane_major_kt(k_fold: np.ndarray, c: int) -> np.ndarray:
     return out
 
 
-def _kernel(kt_ref, data_ref, out_ref):
-    d = data_ref[...].astype(jnp.int32)  # [BT, SUB]
-    planes = []
-    for b in range(8):
-        planes.append(((d >> jnp.int32(b)) & jnp.int32(1)).astype(jnp.int8))
-    bits = jnp.concatenate(planes, axis=1)  # [BT, SUB*8] plane-major
-    partial = jnp.dot(
-        bits, kt_ref[0], preferred_element_type=jnp.int32
-    )  # [BT, 32]
-    s = pl.program_id(1)
+def _make_kernel(bt: int, sub: int, interpret: bool):
+    """Round-3 kernel, sharing the encode kernel's unpack
+    (ops/pallas_encode.unpack_bitplanes): blocks ride sublanes, so
+    the sublane bitcast packs 4 BLOCKS per int32 lane — each block's
+    bits stay inside its own byte lane. Planes land as rows
+    (b, block), so the fold becomes 8 per-plane dots against aligned
+    [SUB, 32] slices of the fold tensor — contraction SUB instead of
+    SUB*8, which doubles the useful bytes per streamed MXU column
+    (16 vs 8)."""
 
-    @pl.when(s == 0)
-    def _init():
-        out_ref[...] = partial
+    def kernel(kt_ref, data_ref, out_ref):
+        from ceph_tpu.ops.pallas_encode import unpack_bitplanes
 
-    @pl.when(s != 0)
-    def _acc():
-        out_ref[...] += partial
+        d = data_ref[...]  # [BT, SUB] uint8
+        bits = unpack_bitplanes(d, interpret)  # [8BT, SUB] (b, block)
+        kt = kt_ref[0]  # [SUB*8, 32] rows b*SUB + j
+        partial = jax.lax.dot_general(
+            bits[0:bt], kt[0:sub],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        for b in range(1, 8):
+            partial += jax.lax.dot_general(
+                bits[b * bt : (b + 1) * bt],
+                kt[b * sub : (b + 1) * sub],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [BT, 32]
+        s = pl.program_id(1)
+
+        @pl.when(s == 0)
+        def _init():
+            out_ref[...] = partial
+
+        @pl.when(s != 0)
+        def _acc():
+            out_ref[...] += partial
+
+    return kernel
 
 
 @functools.partial(
@@ -94,7 +118,7 @@ def _fold_tiled(kt, data, block_bytes, interpret=False):
     sub = block_bytes // nsub
     bt = min(BLOCK_TILE, nblocks)
     acc = pl.pallas_call(
-        _kernel,
+        _make_kernel(bt, sub, interpret),
         grid=(nblocks // bt, nsub),
         in_specs=[
             pl.BlockSpec((1,) + kt.shape[1:], lambda i, s: (s, 0, 0)),
@@ -115,13 +139,15 @@ def _kt_cached(block_bytes: int, c: int):
 
 
 def supported(nblocks: int, block_bytes: int) -> bool:
-    """Tileable: enough blocks to fill a sublane tile evenly and a
-    lane-aligned sub-fold."""
+    """Tileable: enough blocks to fill a sublane tile evenly, a
+    lane-aligned sub-fold, and a block count the sublane bitcast can
+    pack (4 blocks per int32 lane)."""
     sub = min(SUB_BYTES, block_bytes)
     return (
         block_bytes % sub == 0
         and sub % 256 == 0
         and nblocks % min(BLOCK_TILE, nblocks) == 0
+        and nblocks % 4 == 0
         and nblocks >= 8
     )
 

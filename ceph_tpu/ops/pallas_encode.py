@@ -149,10 +149,34 @@ def _emulate_i8_to_i32(x):
     return jax.lax.bitcast_convert_type(xi, jnp.int32)
 
 
-def _make_kernel(c: int, r: int, s: int, pad: int, interpret: bool):
+def unpack_bitplanes(flat, interpret: bool):
+    """In-kernel bit-plane unpack shared by the EC and CRC kernels.
+
+    ``flat`` is [F, T] uint8 with F % 4 == 0. Returns [8F, T] int8
+    bit planes in (plane, row) order: a sublane bitcast packs 4 rows
+    per int32 lane, ONE variable shift over 8 b-major replicas
+    (row-indexed iota) extracts every plane, and the bitcast back
+    scatters each byte's bit to the row it came from. Interpret mode
+    emulates the measured little-endian sublane pack bit-exactly."""
     from jax.experimental.pallas import tpu as pltpu
 
-    f = s * c + pad
+    f, t = flat.shape
+    if interpret:
+        xi = _emulate_rows_to_i32(flat)
+    else:
+        xi = pltpu.bitcast(flat, jnp.int32)  # [F/4, T]
+    X = jnp.concatenate([xi] * 8, axis=0)  # [2F, T]
+    shifts = jax.lax.broadcasted_iota(
+        jnp.int32, (2 * f, t), 0
+    ) // jnp.int32(f // 4)  # row group F/4 rows per plane
+    pb = (X >> shifts) & jnp.int32(0x01010101)
+    if interpret:
+        return _emulate_i32_to_i8(pb)
+    return pltpu.bitcast(pb, jnp.int8)  # [8F, T]
+
+
+def _make_kernel(c: int, r: int, s: int, pad: int, interpret: bool):
+    from jax.experimental.pallas import tpu as pltpu
 
     def kernel(bmat_ref, data_ref, out_ref):
         d = data_ref[:]  # [S, C, T] uint8
@@ -162,23 +186,7 @@ def _make_kernel(c: int, r: int, s: int, pad: int, interpret: bool):
             flat = jnp.concatenate(
                 [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
             )
-        if interpret:
-            xi = _emulate_rows_to_i32(flat)
-        else:
-            xi = pltpu.bitcast(flat, jnp.int32)  # [F/4, T]
-        # One variable shift extracts all 8 planes: replicate the
-        # packed rows b-major, shift row-group b right by b, mask to
-        # the per-byte low bit.
-        X = jnp.concatenate([xi] * 8, axis=0)  # [2F, T]
-        # row group size along axis 0 is F/4 rows per plane
-        shifts = jax.lax.broadcasted_iota(
-            jnp.int32, (2 * f, t), 0
-        ) // jnp.int32(f // 4)
-        pb = (X >> shifts) & jnp.int32(0x01010101)
-        if interpret:
-            bits = _emulate_i32_to_i8(pb)
-        else:
-            bits = pltpu.bitcast(pb, jnp.int8)  # [8F, T] (b, s, i)
+        bits = unpack_bitplanes(flat, interpret)  # [8F, T] (b, s, i)
         acc = jax.lax.dot_general(
             bmat_ref[:], bits,
             (((1,), (0,)), ((), ())),

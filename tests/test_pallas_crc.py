@@ -56,7 +56,8 @@ def test_supported_predicate():
     assert supported(BLOCK_TILE * 2, SUB_BYTES * 4)
     assert not supported(4, 4096)        # too few blocks
     assert not supported(8, 1000)        # lane-unaligned sub-fold
-    assert supported(9, SUB_BYTES * 8)   # small counts tile as-is
+    assert supported(12, SUB_BYTES * 8)  # small counts tile as-is
+    assert not supported(9, SUB_BYTES * 8)  # bitcast needs 4-packs
     assert not supported(BLOCK_TILE + 1, 4096)  # uneven sublane tile
 
 
