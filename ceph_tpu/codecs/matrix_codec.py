@@ -48,6 +48,7 @@ def _dispatch_counters():
 
     b = PerfCountersBuilder(perf_collection, "ec_dispatch")
     for op in ("encode", "decode", "delta"):
+        b.add_u64_counter(f"mesh_{op}", f"{op}s sharded over the mesh")
         b.add_u64_counter(f"pallas_{op}", f"{op}s served by the Pallas kernel")
         b.add_u64_counter(f"einsum_{op}", f"{op}s served by the einsum engine")
         b.add_u64_counter(f"host_{op}", f"{op}s served by host GF tables")
@@ -55,6 +56,12 @@ def _dispatch_counters():
         "pallas_fallback",
         "dispatches where Pallas was enabled on TPU but the shape "
         "could not tile (chunk axis % LANE_TILE != 0)",
+    )
+    b.add_u64_counter(
+        "mesh_fallback",
+        "dispatches where a mesh was installed but neither the stripe "
+        "batch nor the lane axis divided dp (the shard axis always "
+        "zero-pads to sp) and a single-chip route served the op",
     )
     return b.create_perf_counters()
 
@@ -146,8 +153,9 @@ class MatrixErasureCodec(ErasureCodeBase):
     def _encode_stacked(self, stacked: jax.Array) -> jax.Array:
         """Dispatch the parity matmul: host GF tables for small numpy
         inputs, the fused Pallas MXU kernel on TPU when the shape
-        tiles (config-gated), einsum otherwise."""
-        if self._host_sized(stacked):
+        tiles (config-gated), einsum otherwise. A mesh-routable shape
+        outranks the host shortcut (see _active_mesh)."""
+        if not self._mesh_routable(stacked) and self._host_sized(stacked):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_encode")
@@ -157,6 +165,39 @@ class MatrixErasureCodec(ErasureCodeBase):
         return self._dispatch_bitmatrix(
             self._encode_bmat_np, self._encode_bmat, stacked, "encode"
         )
+
+    @staticmethod
+    def _active_mesh():
+        """The configured dispatch mesh, or None. Mesh routing wins
+        over every single-chip path (including the host small-op
+        shortcut) — when the operator installs a mesh, shard fan-out
+        IS the system's dispatch, the way the reference's sub-op
+        fan-out is its distributed backend (SURVEY.md §5.8)."""
+        from ceph_tpu.utils import config
+
+        if not config.get("ec_use_mesh"):
+            return None
+        from ceph_tpu.parallel import dispatch as mesh_dispatch
+
+        return mesh_dispatch.get_mesh()
+
+    def _mesh_routable(self, stacked) -> bool:
+        """True when a mesh is active AND this dispatch shape will
+        actually ride it — the host small-op shortcut stays available
+        for shapes that would only hit mesh_fallback (device launch
+        latency dwarfs the GF math there, same as without a mesh)."""
+        mesh = self._active_mesh()
+        if mesh is None:
+            return False
+        from ceph_tpu.parallel import dispatch as mesh_dispatch
+
+        c = stacked.shape[-2]
+        flat_shape = (
+            int(np.prod(stacked.shape[:-2], initial=1)),
+            c,
+            stacked.shape[-1],
+        )
+        return mesh_dispatch.mesh_supported(mesh, (0, c * 8), flat_shape)
 
     def _dispatch_bitmatrix(
         self,
@@ -173,6 +214,20 @@ class MatrixErasureCodec(ErasureCodeBase):
         from ceph_tpu.ops import pallas_encode as pe
         from ceph_tpu.utils import config
 
+        mesh = self._active_mesh()
+        if mesh is not None:
+            from ceph_tpu.parallel import dispatch as mesh_dispatch
+
+            flat = stacked.reshape((-1,) + stacked.shape[-2:])
+            if mesh_dispatch.mesh_supported(
+                mesh, bmat_np.shape, flat.shape
+            ):
+                _dispatch_counters().inc(f"mesh_{op}")
+                out = mesh_dispatch.mesh_apply_bitmatrix(
+                    mesh, bmat_dev, flat
+                )
+                return out.reshape(stacked.shape[:-2] + out.shape[-2:])
+            _dispatch_counters().inc("mesh_fallback")
         if config.get("ec_use_pallas") and pe.on_tpu():
             if pe.supported((1,) + stacked.shape[-2:]):
                 _dispatch_counters().inc(f"pallas_{op}")
@@ -198,9 +253,11 @@ class MatrixErasureCodec(ErasureCodeBase):
             return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
         vals = [chunks[i] for i in present]
-        if all(
-            isinstance(v, np.ndarray) for v in vals
-        ) and self._host_sized(*vals):
+        if (
+            all(isinstance(v, np.ndarray) for v in vals)
+            and not self._mesh_routable(np.stack(vals, axis=-2))
+            and self._host_sized(*vals)
+        ):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_decode")
@@ -264,8 +321,10 @@ class MatrixErasureCodec(ErasureCodeBase):
         """
         cols = sorted(delta)
         vals = [delta[c] for c in cols]
-        if all(isinstance(v, np.ndarray) for v in vals) and self._host_sized(
-            *vals
+        if (
+            all(isinstance(v, np.ndarray) for v in vals)
+            and not self._mesh_routable(np.stack(vals, axis=-2))
+            and self._host_sized(*vals)
         ):
             from ceph_tpu.gf import gf_apply_bytes_host
 

@@ -4,6 +4,13 @@ from .collectives import (  # noqa: F401
     ring_parity,
     sharded_crc32c,
 )
+from .dispatch import (  # noqa: F401
+    get_mesh,
+    mesh_apply_bitmatrix,
+    mesh_supported,
+    set_mesh,
+    use_mesh,
+)
 from .mesh import (  # noqa: F401
     make_ec_mesh,
     sharded_decode,

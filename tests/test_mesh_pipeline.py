@@ -1,0 +1,194 @@
+"""The mesh dispatch route as a SYSTEM component (round-3 VERDICT #1):
+when a mesh is installed, codec encode/decode/delta shard over it —
+from the codec tier, through ShardExtentMap's drivers, up to a live
+socket cluster — with counter visibility and bit-exact results.
+
+The reference analog: the MOSDECSubOpWrite fan-out IS Ceph's
+distributed backend (msg/async/AsyncMessenger.h:95); here the same
+role is the ring-XOR shard_map over the (dp, sp) mesh
+(parallel/dispatch.py). Runs on the conftest-forced 8-device virtual
+CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs.matrix_codec import _dispatch_counters
+from ceph_tpu.codecs.registry import registry
+from ceph_tpu.parallel import make_ec_mesh, use_mesh
+from ceph_tpu.pipeline.shard_map import ShardExtentMap
+from ceph_tpu.pipeline.stripe import StripeInfo
+
+
+def _snap():
+    pc = _dispatch_counters()
+    return {k: pc.get(k) for k in pc.dump()}
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in after if after[k] != before[k]}
+
+
+@pytest.fixture
+def mesh8():
+    return make_ec_mesh(8, k=8)
+
+
+def test_mesh_encode_decode_delta_bit_exact(rng, mesh8):
+    """Codec tier: all three ops route through the mesh, counters
+    tick, and results match the single-chip path bit for bit."""
+    codec = registry.factory("isa", {"k": "8", "m": "4"})
+    data = {
+        i: rng.integers(0, 256, (4, 4096), np.uint8) for i in range(8)
+    }
+    parity_ref = codec.encode_chunks(data)
+
+    before = _snap()
+    with use_mesh(mesh8):
+        parity = codec.encode_chunks(data)
+        chunks = {**data, **{k: np.asarray(v) for k, v in parity.items()}}
+        del chunks[1], chunks[6]
+        out = codec.decode_chunks({1, 6}, chunks)
+        deltas = {0: rng.integers(0, 256, (4, 4096), np.uint8)}
+        new_parity = codec.apply_delta(
+            deltas, {8 + j: np.asarray(parity[8 + j]) for j in range(4)}
+        )
+    moved = _delta(before, _snap())
+    assert moved.get("mesh_encode", 0) >= 1, moved
+    assert moved.get("mesh_decode", 0) >= 1, moved
+    assert moved.get("mesh_delta", 0) >= 1, moved
+
+    for j in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(parity[8 + j]), np.asarray(parity_ref[8 + j])
+        )
+    np.testing.assert_array_equal(np.asarray(out[1]), data[1])
+    np.testing.assert_array_equal(np.asarray(out[6]), data[6])
+    # delta correctness: applying the delta equals re-encoding patched
+    patched = dict(data)
+    patched[0] = np.bitwise_xor(data[0], deltas[0])
+    reref = codec.encode_chunks(patched)
+    for j in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(new_parity[8 + j]), np.asarray(reref[8 + j])
+        )
+
+
+def test_mesh_disable_via_config(rng, mesh8):
+    """ec_use_mesh=false keeps the single-chip routes even with a
+    mesh installed (runtime kill switch)."""
+    from ceph_tpu.utils import config
+
+    codec = registry.factory("isa", {"k": "4", "m": "2"})
+    data = {
+        i: rng.integers(0, 256, (2, 4096), np.uint8) for i in range(4)
+    }
+    old = config.get("ec_use_mesh")
+    try:
+        config.set("ec_use_mesh", False)
+        before = _snap()
+        with use_mesh(mesh8):
+            codec.encode_chunks(data)
+        moved = _delta(before, _snap())
+        assert moved.get("mesh_encode", 0) == 0, moved
+    finally:
+        config.set("ec_use_mesh", old)
+
+
+def test_mesh_shard_extent_map_rmw(rng, mesh8):
+    """ShardExtentMap.encode / encode_parity_delta / decode under the
+    mesh equal the mesh-off results (the RMW pipeline's device work
+    all flows through here)."""
+    codec = registry.factory("isa", {"k": "8", "m": "4"})
+    sinfo = StripeInfo(8, 4, 8 * 4096)
+
+    def build(with_mesh: bool):
+        smap = ShardExtentMap(sinfo)
+        r = np.random.default_rng(7)
+        for raw in range(8):
+            smap.insert(
+                sinfo.get_shard(raw), 0,
+                r.integers(0, 256, 2 * 4096, dtype=np.uint8),
+            )
+        if with_mesh:
+            with use_mesh(mesh8):
+                smap.encode(codec)
+        else:
+            smap.encode(codec)
+        return smap
+
+    ref = build(False)
+    before = _snap()
+    got = build(True)
+    assert _delta(before, _snap()).get("mesh_encode", 0) >= 1
+    for j in range(4):
+        s = sinfo.get_shard(8 + j)
+        np.testing.assert_array_equal(
+            got.get(s, 0, 2 * 4096), ref.get(s, 0, 2 * 4096)
+        )
+
+    # reconstruct through the mesh: drop two shards, decode
+    lost = {sinfo.get_shard(2), sinfo.get_shard(5)}
+    dec = ShardExtentMap(sinfo)
+    for raw in range(12):
+        s = sinfo.get_shard(raw)
+        if s in lost:
+            continue
+        dec.insert(s, 0, ref.get(s, 0, 2 * 4096))
+    before = _snap()
+    with use_mesh(mesh8):
+        dec.decode(codec, lost, 8 * 2 * 4096)
+    assert _delta(before, _snap()).get("mesh_decode", 0) >= 1
+    for s in lost:
+        np.testing.assert_array_equal(
+            dec.get(s, 0, 2 * 4096), ref.get(s, 0, 2 * 4096)
+        )
+
+
+def test_mesh_cluster_roundtrip(rng):
+    """Live socket cluster with the mesh route forced on: write, read
+    back, kill an OSD, degraded-read — service intact and the mesh
+    counters moved (the cluster fan-out's device work rode the mesh)."""
+    from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+    from ceph_tpu.parallel import set_mesh
+
+    mesh = make_ec_mesh(8, k=4)
+    mon = Monitor()
+    daemons = []
+    for i in range(6):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+    for i in range(6):
+        d = OSDDaemon(i, mon, chunk_size=1024)
+        d.start()
+        daemons.append(d)
+    mon.osd_erasure_code_profile_set(
+        "rs42", {"plugin": "isa", "k": "4", "m": "2"}
+    )
+    mon.osd_pool_create("meshpool", 8, "rs42")
+    client = RadosClient(mon, backoff=0.01)
+    set_mesh(mesh)
+    try:
+        io = client.open_ioctx("meshpool")
+        payload = rng.integers(
+            0, 256, 2 * 4 * 1024, dtype=np.uint8
+        ).tobytes()  # two full stripes
+        before = _snap()
+        io.write("obj", payload)
+        assert io.read("obj") == payload
+        moved = _delta(before, _snap())
+        assert moved.get("mesh_encode", 0) >= 1, moved
+
+        # degraded read: kill one OSD hosting a shard, read again
+        daemons[0].stop()
+        before = _snap()
+        assert io.read("obj") == payload
+        moved = _delta(before, _snap())
+        assert moved.get("mesh_decode", 0) >= 1, moved
+    finally:
+        set_mesh(None)
+        client.shutdown()
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:
+                pass  # daemon 0 is stopped mid-test; double-stop ok
