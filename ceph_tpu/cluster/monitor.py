@@ -190,10 +190,43 @@ class Monitor:
 
     # -- device lifecycle -----------------------------------------------
     def osd_crush_add(
-        self, osd: int, weight: float = 1.0, zone: str = ""
+        self,
+        osd: int,
+        weight: float = 1.0,
+        zone: str = "",
+        location: dict[str, str] | None = None,
+        **loc_kw: str,
     ) -> OSDMap:
-        """Register a device in the crush tree (ceph osd crush add)."""
+        """Register a device in the crush tree (ceph osd crush add).
+
+        ``location`` (or keyword shorthand ``host=.., rack=..``) places
+        the device in the bucket hierarchy; rule-based pools
+        (osd_pool_create failure_domain/crush_rule) select through it.
+        Without a location the device lands directly under the root
+        (and the legacy flat ``zone`` placement still applies for
+        pools without a rule)."""
         with self._command():
+            loc = dict(location or {})
+            loc.update({k: v for k, v in loc_kw.items() if v})
+            if loc:
+                # Reject conflicting topology NOW (a bucket cannot sit
+                # under two parents): build a strict trial hierarchy
+                # over every REGISTERED device (not just in ones — a
+                # conflict must not hide until osd_in).
+                from ceph_tpu.crush import CrushHierarchy
+                from ceph_tpu.placement import Device as _Dev
+
+                trial = CrushHierarchy(strict=True)
+                try:
+                    for o in self.osdmap.osds.values():
+                        if o.id != osd:
+                            trial.add_device(
+                                _Dev(o.id, o.weight, o.zone),
+                                dict(o.location),
+                            )
+                    trial.add_device(_Dev(osd, weight, zone), loc)
+                except ValueError as e:
+                    raise CommandError(str(e)) from e
             prev = self.osdmap.osds.get(osd)
             info = OSDInfo(
                 osd, weight, zone,
@@ -201,8 +234,32 @@ class Monitor:
                 in_=prev.in_ if prev else False,
                 addr=prev.addr if prev else None,
                 new=prev.new if prev else True,
+                location=tuple(sorted(loc.items()))
+                if loc
+                else (prev.location if prev else ()),
             )
             return self._propose(new_osds=(info,))
+
+    def osd_crush_rule_create(
+        self, name: str, steps: tuple
+    ) -> OSDMap:
+        """Install a multi-step crush rule (ceph osd crush rule
+        create-*; steps per crush.CrushHierarchy.run_rule)."""
+        with self._command():
+            from ceph_tpu.crush import validate_rule
+
+            try:
+                norm = validate_rule(steps)
+            except ValueError as e:
+                raise CommandError(str(e)) from e
+            existing = self.osdmap.crush_rules.get(name)
+            if existing is not None:
+                if existing != norm:
+                    raise CommandError(
+                        f"crush rule {name!r} exists with different steps"
+                    )
+                return self.osdmap
+            return self._propose(new_rules=((name, norm),))
 
     def osd_boot(self, osd: int, addr: tuple[str, int]) -> OSDMap:
         """An OSD came up and announced its address (MOSDBoot). A NEW
@@ -215,6 +272,7 @@ class Monitor:
             info = OSDInfo(
                 osd, prev.weight, prev.zone, up=True,
                 in_=prev.in_ or prev.new, addr=addr, new=False,
+                location=prev.location,
             )
             self._failure_reports.pop(osd, None)
             self._down_since.pop(osd, None)
@@ -324,12 +382,25 @@ class Monitor:
         pg_num: int,
         profile_name: str = "",
         distinct_zones: bool = False,
+        crush_rule: str = "",
+        failure_domain: str = "",
     ) -> OSDMap:
+        """Create a pool. ``crush_rule`` binds an installed rule;
+        ``failure_domain`` ("host"/"rack"/...) is the shortcut that
+        auto-creates the standard EC spread rule for that bucket type
+        (ErasureCode::create_rule). An LRC profile with
+        ``crush-locality`` gets the two-level locality rule instead
+        (ErasureCodeLrc.h): layer groups stay inside one locality
+        bucket each."""
         with self._command():
             if name in self.osdmap.pools:
                 raise CommandError(f"pool {name!r} already exists")
             if pg_num <= 0:
                 raise CommandError("pg_num must be positive")
+            if crush_rule and failure_domain:
+                raise CommandError(
+                    "give crush_rule or failure_domain, not both"
+                )
             if not profile_name:
                 profile_name = "default"
                 if profile_name not in self.osdmap.profiles:
@@ -346,6 +417,44 @@ class Monitor:
             plugin, codec = self._validate_profile(profile)
             k = codec.get_data_chunk_count()
             size = codec.get_chunk_count()
+            if failure_domain:
+                from ceph_tpu.crush import ec_rule, lrc_rule
+
+                locality = dict(profile).get("crush-locality", "")
+                if plugin == "lrc" and locality:
+                    # kml form: k+m chunks split into groups of l,
+                    # one LOCAL parity added per group — total chunks
+                    # = k + m + (k+m)/l, each locality group holding
+                    # l + 1 chunks (ErasureCodeLrc.cc parse_kml).
+                    prof = dict(profile)
+                    l = int(prof.get("l", "0") or 0)
+                    km = int(prof.get("k", "0") or 0) + int(
+                        prof.get("m", "0") or 0
+                    )
+                    if l <= 0 or km % l or size % (km // l):
+                        raise CommandError(
+                            "crush-locality needs the kml form with "
+                            "l dividing k+m"
+                        )
+                    groups = km // l
+                    per_group = size // groups
+                    steps = lrc_rule(
+                        groups, per_group, locality, failure_domain
+                    )
+                    # geometry-keyed name: same layout shares the
+                    # rule; a different layout never collides (rules
+                    # are not deletable, so a pool-keyed name would
+                    # pin the geometry forever)
+                    crush_rule = (
+                        f"lrc_{locality}_{failure_domain}_"
+                        f"{groups}x{per_group}"
+                    )
+                else:
+                    steps = ec_rule(failure_domain)
+                    crush_rule = f"ec_{failure_domain}"
+                self.osd_crush_rule_create(crush_rule, steps)
+            elif crush_rule and crush_rule not in self.osdmap.crush_rules:
+                raise CommandError(f"no such crush rule {crush_rule!r}")
             spec = PoolSpec(
                 name=name,
                 pool_id=self._next_pool_id,
@@ -355,6 +464,7 @@ class Monitor:
                 m=size - k,
                 plugin=plugin,
                 distinct_zones=distinct_zones,
+                crush_rule=crush_rule,
             )
             self._next_pool_id += 1
             return self._propose(new_pools=(spec,))

@@ -48,6 +48,9 @@ class OSDInfo:
     in_: bool = False
     addr: tuple[str, int] | None = None
     new: bool = True
+    #: crush location, sorted (type, bucket) pairs — e.g.
+    #: (("host", "h1"), ("rack", "r2")). Empty = flat placement.
+    location: tuple[tuple[str, str], ...] = ()
 
     def to_obj(self) -> dict:
         return {
@@ -58,6 +61,7 @@ class OSDInfo:
             "in": self.in_,
             "addr": list(self.addr) if self.addr else None,
             "new": self.new,
+            "location": [list(kv) for kv in self.location],
         }
 
     @classmethod
@@ -66,6 +70,7 @@ class OSDInfo:
             o["id"], o["weight"], o["zone"], o["up"], o["in"],
             tuple(o["addr"]) if o["addr"] else None,
             o.get("new", False),
+            tuple(tuple(kv) for kv in o.get("location", ())),
         )
 
 
@@ -81,6 +86,8 @@ class PoolSpec:
     m: int
     plugin: str
     distinct_zones: bool = False
+    #: named crush rule (OSDMap.crush_rules); empty = flat straw2
+    crush_rule: str = ""
 
     @property
     def size(self) -> int:
@@ -102,6 +109,7 @@ class PoolSpec:
             "m": self.m,
             "plugin": self.plugin,
             "distinct_zones": self.distinct_zones,
+            "crush_rule": self.crush_rule,
         }
 
     @classmethod
@@ -109,6 +117,7 @@ class PoolSpec:
         return cls(
             o["name"], o["pool_id"], o["pg_num"], o["profile_name"],
             o["k"], o["m"], o["plugin"], o["distinct_zones"],
+            o.get("crush_rule", ""),
         )
 
 
@@ -135,6 +144,8 @@ class Incremental:
     #: serves from this membership until backfill completes
     new_pg_temp: tuple[tuple[str, int, tuple[int, ...]], ...] = ()
     del_pg_temp: tuple[tuple[str, int], ...] = ()
+    #: crush rule installs: ((name, ((step, ...), ...)), ...)
+    new_rules: tuple[tuple[str, tuple[tuple, ...]], ...] = ()
 
     def to_bytes(self) -> bytes:
         return json.dumps({
@@ -154,6 +165,10 @@ class Incremental:
                 for pool, pgid, acting in self.new_pg_temp
             ],
             "del_pg_temp": [list(k) for k in self.del_pg_temp],
+            "new_rules": [
+                [n, [list(s) for s in steps]]
+                for n, steps in self.new_rules
+            ],
         }).encode()
 
     @classmethod
@@ -177,6 +192,10 @@ class Incremental:
                 for pool, pgid, acting in o.get("new_pg_temp", ())
             ),
             tuple(tuple(k) for k in o.get("del_pg_temp", ())),
+            tuple(
+                (n, tuple(tuple(s) for s in steps))
+                for n, steps in o.get("new_rules", ())
+            ),
         )
 
 
@@ -190,6 +209,7 @@ class OSDMap:
         pools: dict[str, PoolSpec] | None = None,
         profiles: dict[str, dict[str, str]] | None = None,
         pg_temp: dict[tuple[str, int], tuple[int, ...]] | None = None,
+        crush_rules: dict[str, tuple] | None = None,
     ) -> None:
         self.epoch = epoch
         self.osds: dict[int, OSDInfo] = dict(osds or {})
@@ -202,6 +222,11 @@ class OSDMap:
         self.pg_temp: dict[tuple[str, int], tuple[int, ...]] = dict(
             pg_temp or {}
         )
+        #: named multi-step crush rules (crush_do_rule programs)
+        self.crush_rules: dict[str, tuple] = {
+            n: tuple(tuple(s) for s in steps)
+            for n, steps in (crush_rules or {}).items()
+        }
         # straw2 input: in-devices with positive weight. Down-but-in
         # devices STAY (holes, not movement).
         self._crush = CrushMap([
@@ -209,6 +234,19 @@ class OSDMap:
             for o in self.osds.values()
             if o.in_ and o.weight > 0
         ])
+        # Bucket hierarchy for rule-based pools: built from device
+        # locations (out devices excluded — they contribute no
+        # weight anywhere, so whole subtrees can empty out).
+        # Non-strict: a historical map must always LOAD; the monitor
+        # rejects conflicting locations at command time.
+        from ceph_tpu.crush import CrushHierarchy
+
+        self._hierarchy = CrushHierarchy(strict=False)
+        for o in self.osds.values():
+            if o.in_ and o.weight > 0:
+                self._hierarchy.add_device(
+                    Device(o.id, o.weight, o.zone), dict(o.location)
+                )
 
     # -- placement arithmetic ------------------------------------------
     def object_to_pg(self, pool: str, oid: str) -> int:
@@ -232,12 +270,19 @@ class OSDMap:
             temp = self.pg_temp.get((pool, pg))
             if temp is not None:
                 return list(temp)
-        n = min(spec.size, len(self._crush.devices))
-        raw = self._crush.select(
-            stable_hash(str(spec.pool_id), pg),
-            n,
-            distinct_zones=spec.distinct_zones,
-        ) if n else []
+        if spec.crush_rule and spec.crush_rule in self.crush_rules:
+            raw = self._hierarchy.run_rule(
+                self.crush_rules[spec.crush_rule],
+                (stable_hash(str(spec.pool_id), pg),),
+                spec.size,
+            )
+        else:
+            n = min(spec.size, len(self._crush.devices))
+            raw = self._crush.select(
+                stable_hash(str(spec.pool_id), pg),
+                n,
+                distinct_zones=spec.distinct_zones,
+            ) if n else []
         return raw + [SHARD_NONE] * (spec.size - len(raw))
 
     def pg_to_up_acting(self, pool: str, pg: int) -> list[int]:
@@ -317,7 +362,12 @@ class OSDMap:
             pg_temp = {
                 k: v for k, v in pg_temp.items() if k[0] != name
             }
-        return OSDMap(self.epoch + 1, osds, pools, profiles, pg_temp)
+        rules = dict(self.crush_rules)
+        for name, steps in incr.new_rules:
+            rules[name] = tuple(tuple(s) for s in steps)
+        return OSDMap(
+            self.epoch + 1, osds, pools, profiles, pg_temp, rules
+        )
 
     # -- serialization --------------------------------------------------
     def to_bytes(self) -> bytes:
@@ -329,6 +379,10 @@ class OSDMap:
             "pg_temp": [
                 [pool, pgid, list(acting)]
                 for (pool, pgid), acting in self.pg_temp.items()
+            ],
+            "crush_rules": [
+                [n, [list(s) for s in steps]]
+                for n, steps in self.crush_rules.items()
             ],
         }).encode()
 
@@ -343,6 +397,10 @@ class OSDMap:
             {
                 (pool, pgid): tuple(acting)
                 for pool, pgid, acting in o.get("pg_temp", ())
+            },
+            {
+                n: tuple(tuple(s) for s in steps)
+                for n, steps in o.get("crush_rules", ())
             },
         )
 
