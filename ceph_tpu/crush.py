@@ -61,6 +61,15 @@ def validate_rule(steps) -> tuple:
     norm = tuple(tuple(s) for s in steps)
     if not norm:
         raise ValueError("empty rule")
+    if norm[0][:1] != ("take",):
+        raise ValueError("rule must start with a take step")
+    if norm[-1] != ("emit",):
+        raise ValueError("rule must end with emit")
+    if not any(
+        s and s[0] in ("choose_firstn", "chooseleaf_firstn")
+        for s in norm
+    ):
+        raise ValueError("rule selects nothing (no choose step)")
     for s in norm:
         if not s:
             raise ValueError("empty rule step")
@@ -148,10 +157,14 @@ class CrushHierarchy:
         self.devices[dev.id] = dev
         self._wcache.clear()
         loc = dict(location or {})
-        # order the location levels least-aggregated first
-        order = [t for t in DEFAULT_TYPES if t in loc] + [
+        # order the location levels least-aggregated first; unknown
+        # types sort ALPHABETICALLY so the order is a function of the
+        # location CONTENT — the monitor's strict validation pass and
+        # the map rebuild must construct the identical tree no matter
+        # what dict order each saw
+        order = [t for t in DEFAULT_TYPES if t in loc] + sorted(
             t for t in loc if t not in DEFAULT_TYPES
-        ]
+        )
         if not order:
             self._dev_parent[dev.id] = self.root_name
             kids = self.buckets[self.root_name].children

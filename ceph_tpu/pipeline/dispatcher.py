@@ -1,0 +1,256 @@
+"""Streaming dispatcher: the native staging ring feeding batched
+device dispatches — SURVEY.md §7 step 4 assembled (host ring ->
+staging -> batched device dispatch -> completion callbacks).
+
+The role it fills is the reference's sharded op queues
+(osd/OSD.cc:9874-9933): many client ops across many PGs land on a
+shared queue and drain in batches. Here the batching axis IS the TPU
+win: one [B, k, L] device encode amortizes the per-dispatch launch
+(and, through a remote-device tunnel, the round trip) over every
+small op in the batch — the per-op path pays it per 4-64 KiB write.
+
+Shape of the machinery:
+
+- producers (OSD daemons, RMW pipelines, any thread) ``submit()``
+  ops into the native MPMC ring (native/src/ceph_tpu_native.cc,
+  ``ctpu_ring_*``) as header+payload slots; the ring is the
+  bounded staging tier — backpressure is a blocking push;
+- ONE dispatcher thread drains the ring: it blocks for the first op,
+  then keeps popping until the ring is momentarily empty past the
+  batching window or ``max_batch`` is reached;
+- ops group by (k, chunk_len) signature; each group stacks into one
+  [B, k, L] batch, encodes through the codec's normal dispatch
+  (device kernel / mesh / einsum — the codec router decides), and
+  completion callbacks fire with each op's parity rows;
+- ``encode_sync`` is the synchronous facade for pipeline callers:
+  submit + wait, with concurrency across threads supplying the batch.
+
+Counters (``perf dump`` section ``ec_stream``): ops, batches,
+batched_ops (ops that shared a dispatch), plus a max-batch gauge.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+import threading
+import time
+from collections import defaultdict
+from collections.abc import Callable
+
+import numpy as np
+
+#: slot header: op id, k, chunk length
+_HDR = struct.Struct("<QHI")
+
+
+@functools.lru_cache(maxsize=1)
+def _stream_counters():
+    from ceph_tpu.utils.perf_counters import (
+        PerfCountersBuilder,
+        perf_collection,
+    )
+
+    b = PerfCountersBuilder(perf_collection, "ec_stream")
+    b.add_u64_counter("ops", "ops submitted to the streaming dispatcher")
+    b.add_u64_counter("batches", "device dispatches issued")
+    b.add_u64_counter(
+        "batched_ops", "ops that shared a dispatch with at least one other"
+    )
+    b.add_u64_gauge("max_batch", "largest batch assembled (high-water)")
+    return b.create_perf_counters()
+
+
+class StreamingDispatcher:
+    """Aggregates concurrent small encodes into batched dispatches."""
+
+    def __init__(
+        self,
+        codec,
+        *,
+        capacity: int = 128,
+        slot_bytes: int = (256 << 10) + _HDR.size,
+        max_batch: int = 128,
+        window_s: float = 0.0005,
+    ) -> None:
+        # Defaults size the ring for its small-op mission (the native
+        # ring allocates capacity*slot_bytes EAGERLY — 32 MiB here,
+        # not the 512 MiB a 1 MiB slot would pin); oversized ops take
+        # the per-op path (see max_op_bytes / shard_map routing).
+        from ceph_tpu.native import RingBuffer
+
+        self.codec = codec
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self._ring = RingBuffer(capacity, slot_bytes)
+        self._slot_payload = slot_bytes - _HDR.size
+        self._lock = threading.Lock()
+        self._next_id = 0
+        #: op id -> (callback, k, chunk_len)
+        self._pending: dict[int, tuple[Callable, int, int]] = {}
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="ec-stream", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def max_op_bytes(self) -> int:
+        """Largest [k, L] payload one slot can stage."""
+        return self._slot_payload
+
+    # -- producer side --------------------------------------------------
+    def submit(
+        self, data: np.ndarray, callback: Callable[[np.ndarray], None]
+    ) -> int:
+        """Queue one encode of ``data`` [k, L] uint8; ``callback``
+        fires (dispatcher thread) with the parity [m, L]."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if data.ndim != 2:
+            raise ValueError(f"want [k, L], got {data.shape}")
+        k, ln = data.shape
+        if k * ln > self._slot_payload:
+            raise ValueError(
+                f"op {k}x{ln} exceeds slot payload {self._slot_payload}"
+            )
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("dispatcher stopped")
+            op_id = self._next_id
+            self._next_id += 1
+            self._pending[op_id] = (callback, k, ln)
+        slot = _HDR.pack(op_id, k, ln) + data.tobytes()
+        self._ring.push(slot, blocking=True)
+        _stream_counters().inc("ops")
+        return op_id
+
+    def encode_sync(self, data: np.ndarray) -> np.ndarray:
+        """Submit + wait; the batch forms from OTHER threads' ops
+        arriving inside the window. A codec failure for the batch
+        re-raises here (the callback receives the exception)."""
+        ev = threading.Event()
+        out: list = []
+
+        def cb(parity) -> None:
+            out.append(parity)
+            ev.set()
+
+        self.submit(data, cb)
+        ev.wait()
+        if isinstance(out[0], BaseException):
+            raise out[0]
+        return out[0]
+
+    # -- dispatcher thread ----------------------------------------------
+    def _drain_loop(self) -> None:
+        while True:
+            first = self._ring.pop(blocking=True)
+            if first is None:  # closed and drained
+                return
+            ops = [first]
+            deadline = time.monotonic() + self.window_s
+            while len(ops) < self.max_batch:
+                nxt = self._ring.pop(blocking=False)
+                if nxt is None:
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.00005)
+                    continue
+                ops.append(nxt)
+            try:
+                self._fire(ops)
+            except Exception:
+                # The drain thread must survive ANYTHING — a dead
+                # drain wedges every producer on the full ring. _fire
+                # already routes per-group failures to callbacks; this
+                # catches bookkeeping bugs.
+                from ceph_tpu.utils.log import get_logger
+
+                get_logger("ec-stream").error(
+                    "drain iteration failed; continuing"
+                )
+
+    def _fire(self, slots: list[bytes]) -> None:
+        pc = _stream_counters()
+        groups: dict[tuple[int, int], list[tuple[int, np.ndarray]]] = (
+            defaultdict(list)
+        )
+        for raw in slots:
+            op_id, k, ln = _HDR.unpack_from(raw)
+            payload = np.frombuffer(
+                raw, np.uint8, count=k * ln, offset=_HDR.size
+            ).reshape(k, ln)
+            groups[(k, ln)].append((op_id, payload))
+        for (k, ln), members in groups.items():
+            try:
+                stacked = np.stack([p for _, p in members])  # [B, k, L]
+                parity = self.codec.encode_chunks(
+                    {i: stacked[:, i, :] for i in range(k)}
+                )
+                m = len(parity)
+                out = np.stack(
+                    [np.asarray(parity[k + j]) for j in range(m)],
+                    axis=1,
+                )  # [B, m, L]
+                results: list = [out[i] for i in range(len(members))]
+                pc.inc("batches")
+                if len(members) > 1:
+                    pc.inc("batched_ops", len(members))
+                if len(members) > pc.get("max_batch"):
+                    pc.set("max_batch", len(members))
+            except Exception as e:
+                # Deliver the failure to every member — a waiting
+                # encode_sync re-raises it; nobody hangs.
+                results = [e] * len(members)
+            for idx, (op_id, _) in enumerate(members):
+                with self._lock:
+                    cb, _, _ = self._pending.pop(op_id)
+                try:
+                    cb(results[idx])
+                except Exception:
+                    from ceph_tpu.utils.log import get_logger
+
+                    get_logger("ec-stream").error(
+                        "completion callback raised for op", op_id
+                    )
+
+    # -- lifecycle -------------------------------------------------------
+    def stop(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._ring.close()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------- routing
+_global: dict[int, StreamingDispatcher] = {}
+_global_lock = threading.Lock()
+
+
+def dispatcher_for(codec) -> StreamingDispatcher:
+    """Per-codec-instance shared dispatcher (lazily created) — the
+    seam ShardExtentMap uses when ``ec_streaming_dispatch`` is on."""
+    key = id(codec)
+    with _global_lock:
+        d = _global.get(key)
+        if d is None:
+            d = StreamingDispatcher(codec)
+            _global[key] = d
+        return d
+
+
+def streaming_enabled() -> bool:
+    from ceph_tpu.utils import config
+
+    if not config.get("ec_streaming_dispatch"):
+        return False
+    from ceph_tpu import native
+
+    return native.available()
+
+
+def shutdown_all() -> None:
+    with _global_lock:
+        for d in _global.values():
+            d.stop()
+        _global.clear()

@@ -195,8 +195,23 @@ class ShardExtentMap:
 
     @staticmethod
     def _dispatch_encode(codec, data: np.ndarray) -> np.ndarray:
-        """[k, L] host -> [m, L] host through the codec's dispatch."""
+        """[k, L] host -> [m, L] host through the codec's dispatch.
+        With ``ec_streaming_dispatch`` on, the op rides the native
+        staging ring and shares a batched device dispatch with other
+        concurrent ops (pipeline/dispatcher.py)."""
+        from .dispatcher import dispatcher_for, streaming_enabled
+
         k = data.shape[0]
+        flat = data.reshape(k, -1)
+        # Sub-chunk codecs (CLAY) give chunk geometry meaning beyond
+        # byte count, and ops beyond a ring slot can't stage — both
+        # keep the per-op path.
+        if streaming_enabled() and codec.get_sub_chunk_count() == 1:
+            disp = dispatcher_for(codec)
+            if flat.nbytes <= disp.max_op_bytes:
+                return disp.encode_sync(flat).reshape(
+                    (-1,) + data.shape[1:]
+                )
         parity = codec.encode_chunks(
             {i: np.asarray(data[i]) for i in range(k)}
         )
