@@ -330,6 +330,21 @@ class OSDDaemon:
         self._completed_ops: "OrderedDict[str, OSDOpReply]" = OrderedDict()
         self._completed_cap = 1024
         self._stopped = False
+        # -- background scrub scheduling (osd/scrubber/osd_scrub.cc):
+        # per-PG stamps drive randomized shallow/deep due times; the
+        # tick kicks due scrubs onto their own thread, capped at
+        # osd_max_scrubs concurrent, each object admitting through the
+        # mClock "scrub" class (client > recovery > scrub).
+        self._scrub_stamps: dict[tuple[str, int], list[float]] = {}
+        self._scrub_jitter: dict[tuple[str, int], float] = {}
+        self._scrubs_running = 0
+        #: PGs with a scrub in flight (stamps only move on completion,
+        #: so without this a slow scrub would be re-scheduled — the
+        #: per-PG reservation role)
+        self._scrubs_inflight: set[tuple[str, int]] = set()
+        self._scrub_lock = threading.Lock()
+        #: (pool, pgid) -> (monotonic stamp, kind, n_errors, repaired)
+        self.scrub_history: dict[tuple[str, int], tuple] = {}
 
     # -- lifecycle ------------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
@@ -1256,10 +1271,151 @@ class OSDDaemon:
     def tick(self) -> None:
         """Periodic maintenance: restart stalled backfills for PGs I
         serve under pg_temp (a failed pass leaves the temp mapping in
-        place; the tick is the retry seam) and finish pool-deletion
-        sweeps."""
+        place; the tick is the retry seam), finish pool-deletion
+        sweeps, and kick due background scrubs."""
         self._adopt_pg_temps()
         self._maybe_gc_pools()
+        self._maybe_schedule_scrubs()
+
+    # -- background scrub scheduler (osd/scrubber/osd_scrub.cc role) ----
+    def _scrub_due(
+        self, key: tuple[str, int], now: float
+    ) -> "str | None":
+        """"deep"/"shallow" when the PG's randomized due time passed,
+        else None. Each PG gets a stable jitter fraction so scrubs
+        spread inside the interval instead of storming together
+        (osd_scrub_interval_randomize_ratio)."""
+        import random
+
+        from ceph_tpu.utils import config
+
+        stamps = self._scrub_stamps.setdefault(key, [0.0, 0.0])
+        jitter = self._scrub_jitter.setdefault(key, random.random())
+        ratio = config.get("osd_scrub_interval_randomize_ratio")
+        shallow_iv = config.get("osd_scrub_min_interval") * (
+            1.0 + jitter * ratio
+        )
+        deep_iv = config.get("osd_deep_scrub_interval") * (
+            1.0 + jitter * ratio
+        )
+        if stamps[1] == 0.0 or now - stamps[1] >= deep_iv:
+            return "deep"
+        if stamps[0] == 0.0 or now - stamps[0] >= shallow_iv:
+            # chance-based early deepening (PrimaryLogScrub's
+            # deep_scrub_on_error/randomize behavior)
+            if random.random() < config.get(
+                "osd_deep_scrub_randomize_ratio"
+            ):
+                return "deep"
+            return "shallow"
+        return None
+
+    def _maybe_schedule_scrubs(self) -> None:
+        import time as _time
+
+        from ceph_tpu.utils import config
+
+        now = _time.monotonic()
+        with self._scrub_lock:
+            if self._scrubs_running >= config.get("osd_max_scrubs"):
+                return
+        with self._pg_lock:
+            keys = list(self._pgs)
+        for key in keys:
+            pool, pgid = key
+            if pool not in self.osdmap.pools:
+                continue
+            if self.osdmap.pg_primary(pool, pgid) != self.osd_id:
+                continue  # only the primary scrubs (reservation holder)
+            kind = self._scrub_due(key, now)
+            if kind is None:
+                continue
+            with self._scrub_lock:
+                if self._scrubs_running >= config.get("osd_max_scrubs"):
+                    return
+                if key in self._scrubs_inflight:
+                    continue  # still running: not due again yet
+                self._scrubs_inflight.add(key)
+                self._scrubs_running += 1
+            threading.Thread(
+                target=self._run_scheduled_scrub,
+                args=(pool, pgid, kind),
+                name=f"scrub-{pool}-{pgid}",
+                daemon=True,
+            ).start()
+
+    def _run_scheduled_scrub(
+        self, pool: str, pgid: int, kind: str
+    ) -> None:
+        import time as _time
+
+        from ceph_tpu.utils import config
+
+        key = (pool, pgid)
+        try:
+            if kind == "deep":
+                results = self.scrub_pg(
+                    pool, pgid,
+                    repair=config.get("osd_scrub_auto_repair"),
+                )
+            else:
+                results = self.scrub_pg_shallow(pool, pgid)
+            n_err = sum(len(r.errors) for r in results)
+            repaired = any(getattr(r, "repaired", False) for r in results)
+            now = _time.monotonic()
+            stamps = self._scrub_stamps.setdefault(key, [0.0, 0.0])
+            stamps[0] = now
+            if kind == "deep":
+                stamps[1] = now
+            self.scrub_history[key] = (now, kind, n_err, repaired)
+            if n_err:
+                self.log.info(
+                    "scheduled", kind, "scrub", f"{pool}/{pgid}:",
+                    n_err, "errors",
+                    "(repaired)" if repaired else "",
+                )
+        except Exception as e:
+            # scrubbing must never take the daemon down; the PG stays
+            # due and the next tick retries
+            self.log.error(
+                "scheduled scrub failed", f"{pool}/{pgid}:",
+                type(e).__name__, e,
+            )
+        finally:
+            with self._scrub_lock:
+                self._scrubs_running -= 1
+                self._scrubs_inflight.discard(key)
+
+    def scrub_pg_shallow(self, pool: str, pgid: int) -> "list":
+        """Metadata-only scrub: every object's shards must agree on
+        the HashInfo attr (consensus without dissent) — no payload
+        reads (the reference's shallow scrub compares metadata only).
+        """
+        from ceph_tpu.pipeline.recovery import ScrubError, ScrubResult
+
+        spec = self.osdmap.pools[pool]
+        pg = self._get_pg(pool, pgid)
+        locs = sorted(self._backfill_scan(pool, pgid, spec, pg))
+        results = []
+        for loc in locs:
+            self.admit("scrub")
+            with self._op_lock:
+                if not self._object_size(pg, loc) and not (
+                    self._have_object(pg, loc)
+                ):
+                    continue
+                result = ScrubResult(loc)
+                hinfo, dissent = self._consensus_hinfo(pg, loc)
+                if hinfo is None:
+                    result.errors.append(ScrubError(
+                        -1, "hinfo_conflict" if dissent else "missing_attr"
+                    ))
+                elif dissent:
+                    result.errors.append(
+                        ScrubError(-1, "hinfo_dissent", str(dissent))
+                    )
+                results.append(result)
+        return results
 
     def _backfill_pg(self, pool: str, pgid: int, pg: _PG) -> None:
         """Move every object of the PG to its CRUSH target layout,

@@ -120,7 +120,13 @@ class StreamingDispatcher:
             self._next_id += 1
             self._pending[op_id] = (callback, k, ln)
         slot = _HDR.pack(op_id, k, ln) + data.tobytes()
-        self._ring.push(slot, blocking=True)
+        if not self._ring.push(slot, blocking=True):
+            # the ring refused the slot (closed by a concurrent
+            # stop()): fail loudly — a silent drop would wedge the
+            # encode_sync waiter forever
+            with self._lock:
+                self._pending.pop(op_id, None)
+            raise RuntimeError("dispatcher stopped")
         _stream_counters().inc("ops")
         return op_id
 
@@ -223,14 +229,32 @@ class StreamingDispatcher:
 
 
 # ---------------------------------------------------------------- routing
-_global: dict[int, StreamingDispatcher] = {}
+_global: dict[tuple, StreamingDispatcher] = {}
 _global_lock = threading.Lock()
 
 
+def _codec_signature(codec) -> tuple:
+    """Batching identity: two codecs with the same signature produce
+    identical parity, so their ops may share a dispatcher (and a
+    batch). Keyed by class + geometry + the encode matrix bytes when
+    available — NOT instance id: PG objects rebuild their codecs on
+    every map change, and an id-keyed cache would leak one ring +
+    thread per rebuild while never batching across PGs."""
+    bmat = getattr(codec, "_encode_bmat_np", None)
+    return (
+        type(codec).__name__,
+        getattr(codec, "k", 0),
+        getattr(codec, "m", 0),
+        bmat.tobytes() if bmat is not None else None,
+    )
+
+
 def dispatcher_for(codec) -> StreamingDispatcher:
-    """Per-codec-instance shared dispatcher (lazily created) — the
-    seam ShardExtentMap uses when ``ec_streaming_dispatch`` is on."""
-    key = id(codec)
+    """Shared dispatcher per codec SIGNATURE (lazily created) — the
+    seam ShardExtentMap uses when ``ec_streaming_dispatch`` is on.
+    Ops from every PG with the same EC profile share one ring and
+    batch together."""
+    key = _codec_signature(codec)
     with _global_lock:
         d = _global.get(key)
         if d is None:

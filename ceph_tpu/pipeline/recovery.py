@@ -414,18 +414,33 @@ def be_deep_scrub(
     hashed = hinfo.get_total_chunk_size()
     if hashed == 0:
         return result  # cleared / empty: nothing to verify
+    from ceph_tpu.utils import config
+
+    stride = max(int(config.get("osd_deep_scrub_stride")), 4096)
     for shard in sorted(backend.avail_shards()):
         store = backend.stores[shard]
-        try:
-            buf = store.read(oid, 0, hashed)
-        except FileNotFoundError:
-            result.errors.append(ScrubError(shard, "read_error", "missing"))
+        # Stride-bounded reads (osd_deep_scrub_stride): the CRC chains
+        # across pieces, so scrub memory/latency stays bounded no
+        # matter the object size (ECBackend.cc:1793-1795).
+        crc = SEED
+        missing = False
+        for off in range(0, hashed, stride):
+            want_len = min(stride, hashed - off)
+            try:
+                buf = store.read(oid, off, want_len)
+            except FileNotFoundError:
+                result.errors.append(
+                    ScrubError(shard, "read_error", "missing")
+                )
+                missing = True
+                break
+            # Ragged tails: stored bytes short of the hashed window
+            # were hashed as zeros at encode time (zero-padding).
+            if len(buf) < want_len:
+                buf = buf + b"\0" * (want_len - len(buf))
+            crc = crc32c_ref(crc, buf)
+        if missing:
             continue
-        # Ragged tails: stored bytes short of the hashed window were
-        # hashed as zeros at encode time (zero-padding convention).
-        if len(buf) < hashed:
-            buf = buf + b"\0" * (hashed - len(buf))
-        crc = crc32c_ref(SEED, buf)
         want = hinfo.get_chunk_hash(shard)
         if crc != want:
             result.errors.append(

@@ -277,3 +277,110 @@ def test_live_history_beats_stale_plurality(cluster):
     )
     assert row.repaired
     assert io.read("obj") == data2
+
+
+# -- background scrub scheduling (osd/scrubber/osd_scrub.cc role) --------
+def _scrub_config(monkeypatch_vals):
+    from ceph_tpu.utils import config
+
+    saved = {}
+    for k, v in monkeypatch_vals.items():
+        saved[k] = config.get(k)
+        config.set(k, v)
+    return saved
+
+
+def _restore_config(saved):
+    from ceph_tpu.utils import config
+
+    for k, v in saved.items():
+        config.set(k, v)
+
+
+def test_scheduler_finds_and_repairs_bitrot(cluster):
+    """The VERDICT r2 'done' criterion: injected bitrot is found and
+    repaired by the SCHEDULER (tick-driven randomized intervals +
+    auto-repair), not a manual scrub_pg call — while client IO keeps
+    flowing."""
+    import time
+
+    mon, daemons, client = cluster
+    saved = _scrub_config({
+        "osd_scrub_min_interval": 0.05,
+        "osd_deep_scrub_interval": 0.05,
+        "osd_scrub_auto_repair": True,
+    })
+    try:
+        io = client.open_ioctx("ecpool")
+        data = payload(9_000)
+        io.write("obj", data)
+        osd = corrupt_shard(mon, daemons, "obj", position=1)
+        pgid = mon.osdmap.object_to_pg("ecpool", "obj")
+        primary = mon.osdmap.primary("ecpool", "obj")
+        # drive ticks by hand (fixture daemons run tick_period=0);
+        # client IO interleaves to show scrubs don't starve it
+        deadline = time.time() + 30
+        repaired = False
+        while time.time() < deadline and not repaired:
+            for d in daemons:
+                d.tick()
+            # IO keeps SERVING throughout (no starvation/deadlock);
+            # content equality only holds once the repair lands —
+            # until then the read faithfully returns the rotted shard
+            # (per-read CRC is the store tier's job, not EC's)
+            assert len(io.read("obj")) == len(data)
+            hist = daemons[primary].scrub_history.get(("ecpool", pgid))
+            repaired = bool(hist and hist[1] == "deep" and hist[3])
+            time.sleep(0.05)
+        assert repaired, (
+            "scheduler never repaired the bitrot:",
+            daemons[primary].scrub_history,
+        )
+        assert io.read("obj") == data  # clean after repair
+        # the corrupted store is clean again: a manual verify pass
+        # finds nothing
+        (res,) = run_scrub(mon, daemons, "obj")
+        assert res.ok, res.errors
+        assert osd is not None
+    finally:
+        _restore_config(saved)
+
+
+def test_scheduler_stamps_and_shallow_deep_cadence(cluster):
+    """Shallow scrubs run on the short interval, deep on the long
+    one; stamps advance so a scrubbed PG is not immediately re-due."""
+    import time
+
+    mon, daemons, client = cluster
+    saved = _scrub_config({
+        "osd_scrub_min_interval": 0.05,
+        "osd_deep_scrub_interval": 1e6,
+        "osd_deep_scrub_randomize_ratio": 0.0,
+        "osd_scrub_auto_repair": False,
+    })
+    try:
+        io = client.open_ioctx("ecpool")
+        io.write("obj", payload(5_000))
+        pgid = mon.osdmap.object_to_pg("ecpool", "obj")
+        primary = mon.osdmap.primary("ecpool", "obj")
+        deadline = time.time() + 30
+        hist = None
+        # first scheduled scrub is DEEP (no deep stamp yet)
+        while time.time() < deadline:
+            daemons[primary].tick()
+            hist = daemons[primary].scrub_history.get(("ecpool", pgid))
+            if hist:
+                break
+            time.sleep(0.02)
+        assert hist and hist[1] == "deep"
+        # next due cycle runs SHALLOW (deep stamp fresh, huge interval)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            daemons[primary].tick()
+            hist = daemons[primary].scrub_history.get(("ecpool", pgid))
+            if hist and hist[1] == "shallow":
+                break
+            time.sleep(0.02)
+        assert hist and hist[1] == "shallow", hist
+    finally:
+        _restore_config(saved)
