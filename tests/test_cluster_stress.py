@@ -17,18 +17,19 @@ from ceph_tpu.codecs.registry import registry
 
 def _roundtrip(codec, rng, nbytes, lose):
     k = codec.get_data_chunk_count()
-    n = codec.get_chunk_count()
     data = {
         i: rng.integers(0, 256, (nbytes,), np.uint8) for i in range(k)
     }
-    chunks = {**data, **codec.encode_chunks(data)}
+    parity = codec.encode_chunks(data)
+    originals = {**data, **{i: np.asarray(p) for i, p in parity.items()}}
+    chunks = dict(originals)
     for i in lose:
         del chunks[i]
     out = codec.decode_chunks(set(lose), chunks)
     for i in lose:
-        if i < k:
-            np.testing.assert_array_equal(np.asarray(out[i]), data[i])
-    assert n == len(data) + codec.get_coding_chunk_count()
+        np.testing.assert_array_equal(
+            np.asarray(out[i]), originals[i]
+        )
 
 
 def test_shec_codec_hammered_from_threads():
@@ -89,9 +90,13 @@ def test_isa_decode_table_cache_threads():
 
 def test_rmw_commit_order_no_double_fire_under_racing_acks():
     """In-order commit and exactly-once callbacks survive adversarial
-    concurrency: sub-write acks released by 4 racing threads while
-    ops are in flight (waiting_commit / completed_to contract,
-    ECCommon.h:553-555)."""
+    ack ORDER: a releaser thread fires deferred sub-write acks in a
+    different shuffled order every round while ops are in flight
+    (waiting_commit / completed_to contract, ECCommon.h:553-555).
+    One releaser, not several — release_deferred is a caller-thread
+    hook like the rest of the pipeline (the single-threaded-drain
+    contract); racing it would test a harness race, not the
+    pipeline."""
     from ceph_tpu.pipeline.rmw import RMWPipeline, ShardBackend
     from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
     from ceph_tpu.store import MemStore
@@ -128,13 +133,16 @@ def test_rmw_commit_order_no_double_fire_under_racing_acks():
     stop = threading.Event()
 
     def releaser():
+        import random
+
+        shard_ids = list(range(k + m))
         while not stop.is_set():
-            backend.release_deferred()
+            random.shuffle(shard_ids)
+            backend.release_deferred(order=list(shard_ids))
             time.sleep(0.001)
 
-    threads = [threading.Thread(target=releaser) for _ in range(4)]
-    for t in threads:
-        t.start()
+    t_rel = threading.Thread(target=releaser)
+    t_rel.start()
     deadline = time.time() + 20
     while time.time() < deadline:
         with commit_lock:
@@ -142,13 +150,12 @@ def test_rmw_commit_order_no_double_fire_under_racing_acks():
                 break
         time.sleep(0.01)
     stop.set()
-    for t in threads:
-        t.join()
+    t_rel.join()
     # exactly once, in submission order — the two invariants
     assert committed == list(range(1, n_ops + 1)), committed
 
 
-def test_cluster_hammer_under_membership_thrash(rng):
+def test_cluster_hammer_under_membership_thrash():
     """6 writer threads hammer one pool through their own clients
     while a thrasher downs/revives an OSD; when the dust settles every
     object reads back as its last write and reconstruct still works
@@ -188,8 +195,8 @@ def test_cluster_hammer_under_membership_thrash(rng):
                 io.write(oid, data)
                 with finals_lock:
                     finals[oid] = data
-                got = io.read(oid)
-                assert len(got) == len(data)
+                # oids are writer-private: full content must match
+                assert io.read(oid) == data
         except Exception as e:  # pragma: no cover
             errors.append((wid, e))
         finally:
