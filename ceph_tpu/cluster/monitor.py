@@ -469,6 +469,43 @@ class Monitor:
             self._next_pool_id += 1
             return self._propose(new_pools=(spec,))
 
+    def osd_pool_snap_create(self, pool: str, snap: str) -> OSDMap:
+        """Pool snapshot (rados_ioctx_snap_create,
+        librados/librados_c.cc:1749): commit a new (snapid, name,
+        epoch) entry; primaries clone objects copy-on-first-write
+        against the newest snap."""
+        from dataclasses import replace
+
+        with self._command():
+            spec = self.osdmap.pools.get(pool)
+            if spec is None:
+                raise CommandError(f"no such pool: {pool!r}")
+            if any(n == snap for _, n, _ in spec.snaps):
+                raise CommandError(f"snap {snap!r} already exists")
+            snapid = spec.snap_seq + 1
+            new = replace(
+                spec,
+                snaps=spec.snaps + ((snapid, snap, self.osdmap.epoch + 1),),
+                snap_seq=snapid,
+            )
+            return self._propose(new_pools=(new,))
+
+    def osd_pool_snap_rm(self, pool: str, snap: str) -> OSDMap:
+        """Drop a pool snapshot; members garbage-collect its clone
+        shards on their next tick."""
+        from dataclasses import replace
+
+        with self._command():
+            spec = self.osdmap.pools.get(pool)
+            if spec is None:
+                raise CommandError(f"no such pool: {pool!r}")
+            keep = tuple(s for s in spec.snaps if s[1] != snap)
+            if len(keep) == len(spec.snaps):
+                raise CommandError(f"no such snap: {snap!r}")
+            return self._propose(
+                new_pools=(replace(spec, snaps=keep),)
+            )
+
     def osd_pool_rm(self, name: str) -> OSDMap:
         with self._command():
             if name not in self.osdmap.pools:

@@ -25,7 +25,12 @@ import itertools
 import threading
 import time
 
-from ceph_tpu.msg.messages import OSDOp, OSDOpReply
+from ceph_tpu.msg.messages import (
+    NotifyAck,
+    OSDOp,
+    OSDOpReply,
+    WatchNotify,
+)
 from ceph_tpu.msg.messenger import Connection, Messenger
 
 from .osdmap import SHARD_NONE
@@ -67,6 +72,9 @@ class Objecter:
         self._reqs = itertools.count(1)
         self._lock = threading.Lock()
         self._waiting: dict[int, dict] = {}  # tid -> {event, reply}
+        #: watch cookie -> callback(oid, payload)
+        self._watch_cbs: dict[str, object] = {}
+        self._watch_seq = itertools.count(1)
         self._aio_executor = None
         #: ops resent so far (visible to tests: the resend contract)
         self.resends = 0
@@ -83,6 +91,9 @@ class Objecter:
         return conn
 
     def _dispatch(self, conn: Connection, msg) -> None:
+        if isinstance(msg, WatchNotify):
+            self._handle_watch_notify(conn, msg)
+            return
         if not isinstance(msg, OSDOpReply):
             return
         with self._lock:
@@ -90,6 +101,22 @@ class Objecter:
         if entry is not None:
             entry["reply"] = msg
             entry["event"].set()
+
+    def _handle_watch_notify(self, conn: Connection, msg) -> None:
+        """Watch event push from a primary: run the registered
+        callback (reader thread — keep it quick, like librados
+        watch callbacks), then ack so the notifier unblocks."""
+        with self._lock:
+            cb = self._watch_cbs.get(msg.cookie)
+        if cb is not None:
+            try:
+                cb(msg.oid, msg.payload)
+            except Exception:
+                pass  # a broken callback must still ack
+        try:
+            conn.send(NotifyAck(msg.notify_id, msg.cookie))
+        except (ConnectionError, OSError):
+            pass
 
     # -- op submission (the op_submit → _calc_target loop) --------------
     def submit(
@@ -101,6 +128,7 @@ class Objecter:
         length: int = 0,
         data: bytes = b"",
         name: str = "",
+        snap: int = 0,
     ) -> OSDOpReply:
         last = "no attempt made"
         reqid = f"{self.client_id}.{next(self._reqs)}"
@@ -134,7 +162,8 @@ class Objecter:
             try:
                 self._conn(addr).send(
                     OSDOp(tid, osdmap.epoch, pool, oid, op,
-                          offset, length, data, name, reqid=reqid)
+                          offset, length, data, name, reqid=reqid,
+                          snap=snap)
                 )
                 if not entry["event"].wait(self.op_timeout):
                     last = f"osd.{primary} timed out"
@@ -274,9 +303,18 @@ class IoCtx:
             pass
         return self.write(oid, data, 0)
 
-    def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+    def read(
+        self,
+        oid: str,
+        offset: int = 0,
+        length: int = 0,
+        snap: "int | str" = 0,
+    ) -> bytes:
+        """Read the head, or the object's state at a pool snapshot
+        (``snap`` by name or id — rados_ioctx_snap_set_read role)."""
         return self.objecter.submit(
-            self.pool, oid, "read", offset=offset, length=length
+            self.pool, oid, "read", offset=offset, length=length,
+            snap=self._snapid(snap),
         ).data
 
     def stat(self, oid: str) -> int:
@@ -284,6 +322,83 @@ class IoCtx:
 
     def remove(self, oid: str) -> None:
         self.objecter.submit(self.pool, oid, "remove")
+
+    # -- pool snapshots (rados_ioctx_snap_*, librados_c.cc:1749) -------
+    def _spec(self):
+        spec = self.objecter.monitor.osdmap.pools.get(self.pool)
+        if spec is None:
+            raise FileNotFoundError(f"no such pool {self.pool!r}")
+        return spec
+
+    def _snapid(self, snap: "int | str") -> int:
+        if isinstance(snap, int):
+            return snap
+        for sid, name, _e in self._spec().snaps:
+            if name == snap:
+                return sid
+        raise FileNotFoundError(f"{self.pool}: no such snap {snap!r}")
+
+    def snap_create(self, name: str) -> int:
+        self.objecter.monitor.osd_pool_snap_create(self.pool, name)
+        return self._snapid(name)
+
+    def snap_remove(self, name: str) -> None:
+        self.objecter.monitor.osd_pool_snap_rm(self.pool, name)
+
+    def snap_list(self) -> list[tuple[int, str]]:
+        return [(sid, n) for sid, n, _e in self._spec().snaps]
+
+    def snap_rollback(self, oid: str, snap: "int | str") -> None:
+        """Head becomes the object's state at the snapshot
+        (rados_ioctx_snap_rollback)."""
+        self.objecter.submit(
+            self.pool, oid, "rollback", snap=self._snapid(snap)
+        )
+
+    # -- watch / notify (rados_watch / rados_notify) -------------------
+    def watch(self, oid: str, callback) -> str:
+        """Register ``callback(oid, payload)`` for notifies on the
+        object; returns the watch cookie. Soft state on the primary —
+        re-watch after a primary change (the reference's watch
+        timeout/re-watch contract, collapsed to explicit re-watch)."""
+        cookie = (
+            f"{self.objecter.client_id}.w"
+            f"{next(self.objecter._watch_seq)}"
+        )
+        with self.objecter._lock:
+            self.objecter._watch_cbs[cookie] = callback
+        try:
+            self.objecter.submit(self.pool, oid, "watch", name=cookie)
+        except Exception:
+            with self.objecter._lock:  # failed watch leaves no residue
+                self.objecter._watch_cbs.pop(cookie, None)
+            raise
+        return cookie
+
+    def unwatch(self, oid: str, cookie: str) -> None:
+        self.objecter.submit(self.pool, oid, "unwatch", name=cookie)
+        with self.objecter._lock:
+            self.objecter._watch_cbs.pop(cookie, None)
+
+    def notify(
+        self, oid: str, payload: bytes = b"", timeout_ms: int = 1000
+    ) -> dict:
+        """Deliver ``payload`` to every watcher; returns
+        {"acked": [cookies], "missed": [cookies]} once all ack or the
+        timeout lapses. Delivery is AT-LEAST-ONCE: a lost reply makes
+        the objecter resend, and watchers may see the payload again
+        (the reference's notify has the same retry face; make
+        callbacks idempotent). The wait is bounded below the op
+        timeout so a slow-acking watcher set cannot force a resend by
+        itself."""
+        import json as _json
+
+        cap_ms = max(int((self.objecter.op_timeout - 5.0) * 1000), 100)
+        reply = self.objecter.submit(
+            self.pool, oid, "notify",
+            data=bytes(payload), length=min(timeout_ms, cap_ms),
+        )
+        return _json.loads(reply.data.decode())
 
     # -- xattrs (rados_{get,set,rm}xattr + getxattrs) ------------------
     def setxattr(self, oid: str, name: str, value: bytes) -> None:

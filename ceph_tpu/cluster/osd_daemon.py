@@ -40,12 +40,14 @@ from ceph_tpu.msg.messages import (
     ECSubWrite,
     ECSubWriteReply,
     GetAttrs,
+    NotifyAck,
     OSDOp,
     OSDOpReply,
     PGList,
     PGListReply,
     Ping,
     Pong,
+    WatchNotify,
 )
 from ceph_tpu.msg.messages import serve_get_attrs
 from ceph_tpu.msg.messenger import Connection, Messenger
@@ -73,7 +75,7 @@ from .osdmap import OSDMap, SHARD_NONE
 
 #: ops whose re-application a lost-reply resend must not repeat
 _MUTATING_OPS = frozenset(
-    {"write", "remove", "setxattr", "rmxattr", "omapset"}
+    {"write", "remove", "setxattr", "rmxattr", "omapset", "rollback"}
 )
 
 
@@ -87,6 +89,27 @@ def make_loc(pool_id: int, oid: str) -> str:
 def split_loc(loc: str) -> tuple[int, str]:
     pool_id, _, oid = loc.partition(":")
     return int(pool_id), oid
+
+
+#: separator between a head loc and its snapshot-clone suffix. Clones
+#: are full objects living in the HEAD's PG (the hobject snap field
+#: role, src/common/hobject.h — placement hashes the head name only).
+SNAP_SEP = "\x1fsnap\x1f"
+
+
+def clone_loc(loc: str, snapid: int) -> str:
+    return f"{loc}{SNAP_SEP}{snapid}"
+
+
+def head_of_loc(loc: str) -> str:
+    """The head object's loc (identity for non-clones)."""
+    return loc.split(SNAP_SEP, 1)[0]
+
+
+def snap_of_loc(loc: str) -> int:
+    """Clone's snapid, 0 for a head object."""
+    parts = loc.split(SNAP_SEP, 1)
+    return int(parts[1]) if len(parts) == 2 else 0
 
 
 def shard_key(loc: str, shard: int) -> str:
@@ -345,6 +368,12 @@ class OSDDaemon:
         self._scrub_lock = threading.Lock()
         #: (pool, pgid) -> (monotonic stamp, kind, n_errors, repaired)
         self.scrub_history: dict[tuple[str, int], tuple] = {}
+        # -- watch/notify soft state (osd/Watch.cc role)
+        self._watch_lock = threading.Lock()
+        #: (pool, loc) -> {cookie: Connection}
+        self._watchers: dict[tuple[str, str], dict] = {}
+        self._pending_notifies: dict[int, tuple] = {}
+        self._next_notify_id = 1
 
     # -- lifecycle ------------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
@@ -737,8 +766,11 @@ class OSDDaemon:
                 continue
             if (
                 pool_id2 == pool_id
-                and stable_hash(str(pool_id), oid) % pg_num == pgid
+                and stable_hash(str(pool_id), head_of_loc(oid))
+                % pg_num == pgid
             ):
+                # clones hash by their HEAD name: they live (and
+                # backfill, recover, scrub) in the head's PG
                 out.append((loc, si))
         return out
 
@@ -903,6 +935,8 @@ class OSDDaemon:
             self._handle_pg_list(conn, msg)
         elif isinstance(msg, OSDOp):
             self._handle_client_op(conn, msg)
+        elif isinstance(msg, NotifyAck):
+            self._handle_notify_ack(msg)
 
     def _handle_sub_read(self, conn: Connection, msg: ECSubRead) -> None:
         def reply(_shard, result) -> None:
@@ -950,6 +984,13 @@ class OSDDaemon:
         (OSD::enqueue_op -> mClock queue -> dequeue_op, osd/OSD.cc:
         9874,9933). Cost scales with payload so a large write consumes
         proportionally more of the class's rate."""
+        if msg.op in ("watch", "unwatch", "notify"):
+            # Watch plumbing runs on the READER thread, not the op
+            # worker: a notify waits for acks (which arrive on OTHER
+            # connections' readers), and parking the single worker on
+            # it would freeze every queued read/write on this primary.
+            self._run_client_op(conn, msg)
+            return
         cost = 1.0 + max(len(msg.data), msg.length) / 65536.0
         self._schedule(
             "client", lambda: self._run_client_op(conn, msg), cost
@@ -957,7 +998,7 @@ class OSDDaemon:
 
     def _run_client_op(self, conn: Connection, msg: OSDOp) -> None:
         try:
-            reply = self._execute_client_op(msg)
+            reply = self._execute_client_op(msg, conn)
         except Exception as e:  # never kill the worker
             self.log.error(
                 "client op", msg.op, f"{msg.pool}/{msg.oid}",
@@ -968,7 +1009,9 @@ class OSDDaemon:
             )
         conn.send(reply)
 
-    def _execute_client_op(self, msg: OSDOp) -> OSDOpReply:
+    def _execute_client_op(
+        self, msg: OSDOp, conn: "Connection | None" = None
+    ) -> OSDOpReply:
         epoch = self.osdmap.epoch
         spec = self.osdmap.pools.get(msg.pool)
         if spec is None:
@@ -982,7 +1025,16 @@ class OSDDaemon:
         if self.osdmap.primary(msg.pool, msg.oid) != self.osd_id:
             return OSDOpReply(msg.tid, epoch, error="eagain")
         pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
+        client_oid = msg.oid
         msg.oid = make_loc(spec.pool_id, msg.oid)  # pool-scoped store key
+        # watch/notify live OUTSIDE the op lock: a notify waits for
+        # acks (reader threads deliver them) and must not starve IO
+        if msg.op == "watch":
+            return self._op_watch(msg, conn)
+        if msg.op == "unwatch":
+            return self._op_unwatch(msg)
+        if msg.op == "notify":
+            return self._op_notify(msg, client_oid)
         with self._op_lock:
             if msg.op in _MUTATING_OPS and msg.reqid:
                 cached = self._completed_ops.get(msg.reqid)
@@ -992,9 +1044,21 @@ class OSDDaemon:
                         size=cached.size, data=cached.data,
                     )
             pg = self._get_pg(msg.pool, pgid)
+            if msg.op in _MUTATING_OPS:
+                # copy-on-first-write after a pool snapshot: the head
+                # must be preserved as the newest snap's clone BEFORE
+                # any mutation lands (make_writeable role,
+                # osd/PrimaryLogPG.cc)
+                self._maybe_cow(pg, spec, msg.oid)
             if msg.op == "write":
                 return self._record_completed(msg, self._op_write(pg, msg))
+            if msg.op == "rollback":
+                return self._record_completed(
+                    msg, self._op_rollback(pg, spec, msg)
+                )
             if msg.op == "read":
+                if msg.snap:
+                    return self._op_snap_read(pg, spec, msg)
                 return self._op_read(pg, msg)
             if msg.op == "stat":
                 if not self._object_exists(pg, msg.oid):
@@ -1085,6 +1149,272 @@ class OSDDaemon:
                 pg.backfill_dirty.add(msg.oid)
         return OSDOpReply(msg.tid, self.osdmap.epoch)
 
+    # -- snapshots (pool snaps + clone-on-first-write) ------------------
+    def _read_full(self, pg: _PG, loc: str) -> bytes:
+        """Whole-object read through the read pipeline (reconstructs
+        under erasures like any client read). Caller holds _op_lock."""
+        size = self._object_size(pg, loc)
+        if size == 0:
+            return b""
+        done: list = []
+        pg.reads.submit(
+            loc, 0, size, on_complete=lambda op: done.append(op)
+        )
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        op = done[0]
+        if op.error is not None:
+            raise IOError(f"read {loc}: {op.error}")
+        return op.data
+
+    def _write_internal(self, pg: _PG, loc: str, data: bytes) -> None:
+        done: list = []
+        pg.rmw.submit(loc, 0, data, on_commit=lambda op: done.append(op))
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        if done[0].error is not None:
+            raise IOError(f"write {loc}: {done[0].error}")
+
+    def _remove_internal(self, pg: _PG, loc: str) -> None:
+        done: list = []
+        pg.rmw.submit_remove(loc, on_commit=lambda op: done.append(op))
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+
+    def _maybe_cow(self, pg: _PG, spec, loc: str) -> None:
+        """Preserve the head as the newest snap's clone before the
+        first mutation after that snap. The head predates the snap iff
+        its last-write epoch <= the snap's creation epoch — objects
+        created after the snap never clone (and snap reads of them
+        answer enoent). Caller holds _op_lock."""
+        if not spec.snaps or snap_of_loc(loc):
+            return  # no snaps / already a clone (rollback internals)
+        snapid, _name, snap_epoch = spec.snaps[-1]
+        cl = clone_loc(loc, snapid)
+        if self._object_exists(pg, cl):
+            return
+        if not self._object_exists(pg, loc):
+            return
+        # A write stamped at the snap's own commit epoch happened
+        # AFTER it (the snap commit is itself the map change) — only
+        # strictly-older eversions predate the snap.
+        ev = self._authoritative_eversion(pg, loc)
+        if ev is not None and ev[0] >= snap_epoch:
+            return  # head born/written after the snap: nothing to keep
+        data = self._read_full(pg, loc)
+        self._write_internal(pg, cl, data)
+        attrs = dict(self._replicated_attrs(pg, loc))
+        # The clone remembers the epoch its CONTENT was last written
+        # at — older snaps consult it to tell "existed then" from
+        # "born between snaps" (see _resolve_snap). Replicated (u:)
+        # so shard rebuilds keep it; the \x1f makes client-namespace
+        # collisions impossible.
+        attrs["u:\x1forigin"] = str(ev[0] if ev else 0).encode()
+        done: list = []
+        pg.rmw.submit_attr_updates(
+            cl, attrs, on_commit=lambda op: done.append(op)
+        )
+        pg.backend.drain_until(
+            lambda: bool(done), timeout=self.op_timeout
+        )
+
+    def _resolve_snap(
+        self, pg: _PG, spec, loc: str, snapid: int
+    ) -> "str | None":
+        """The loc serving a read at snapshot ``snapid``: the oldest
+        clone at-or-after it, else the head when the head predates the
+        snap, else None (object did not exist then)."""
+        entry = next(
+            (s for s in spec.snaps if s[0] == snapid), None
+        )
+        if entry is None:
+            return None  # snap deleted (or never existed)
+        for sid, _n, _e in spec.snaps:
+            if sid < snapid:
+                continue
+            cl = clone_loc(loc, sid)
+            if self._object_exists(pg, cl):
+                # A later clone only serves an EARLIER snap if its
+                # content predates that snap — otherwise the object
+                # was born between the snaps and reading the clone
+                # would resurrect it at a time it did not exist.
+                origin = self._replicated_attrs(
+                    pg, cl, ("u:\x1forigin",)
+                ).get("u:\x1forigin")
+                if origin is not None and int(origin) >= entry[2]:
+                    return None  # monotonic: later clones only newer
+                return cl
+        if self._object_exists(pg, loc):
+            ev = self._authoritative_eversion(pg, loc)
+            # strictly-older epoch = head predates the snap (same
+            # strictness as _maybe_cow; an unknown eversion reads as
+            # old — serving stale head beats refusing a valid read)
+            if ev is None or ev[0] < entry[2]:
+                return loc
+        return None
+
+    def _op_snap_read(self, pg: _PG, spec, msg: OSDOp) -> OSDOpReply:
+        src = self._resolve_snap(pg, spec, msg.oid, msg.snap)
+        if src is None:
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        redirected = OSDOp(
+            msg.tid, msg.epoch, msg.pool, src, "read",
+            msg.offset, msg.length,
+        )
+        return self._op_read(pg, redirected)
+
+    def _op_rollback(self, pg: _PG, spec, msg: OSDOp) -> OSDOpReply:
+        """rados_ioctx_snap_rollback: head becomes the snap's content
+        (the pre-rollback head was preserved by the _maybe_cow that
+        ran before this op)."""
+        src = self._resolve_snap(pg, spec, msg.oid, msg.snap)
+        if src is None:
+            return OSDOpReply(msg.tid, self.osdmap.epoch, error="enoent")
+        data = self._read_full(pg, src) if src != msg.oid else None
+        if data is None:
+            return OSDOpReply(msg.tid, self.osdmap.epoch)  # already it
+        # the snapshot's ATTR state comes back too (minus the clone's
+        # internal origin marker) — _maybe_cow preserved it for this
+        attrs = {
+            k: v
+            for k, v in self._replicated_attrs(pg, src).items()
+            if k != "u:\x1forigin"
+        }
+        self._remove_internal(pg, msg.oid)
+        self._write_internal(pg, msg.oid, data)
+        if attrs:
+            done: list = []
+            pg.rmw.submit_attr_updates(
+                msg.oid, attrs, on_commit=lambda op: done.append(op)
+            )
+            pg.backend.drain_until(
+                lambda: bool(done), timeout=self.op_timeout
+            )
+        if pg.backfilling:
+            with self._pg_lock:
+                pg.backfill_dirty.add(msg.oid)
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch, size=len(data)
+        )
+
+    def _gc_dropped_snaps(self) -> None:
+        """Tick sweep: delete my shard keys of clones whose snapid the
+        pool no longer lists (snap trimming, each member trims its own
+        shards independently). The store scan only runs when the
+        cluster's snap state CHANGED since the last sweep (plus once
+        at startup), so steady-state ticks pay nothing."""
+        state = tuple(
+            sorted(
+                (spec.pool_id, tuple(s[0] for s in spec.snaps))
+                for spec in self.osdmap.pools.values()
+            )
+        )
+        if state == getattr(self, "_snap_state_swept", None):
+            return
+        self._snap_state_swept = state
+        live: dict[int, set[int]] = {}
+        for spec in self.osdmap.pools.values():
+            live[spec.pool_id] = {s[0] for s in spec.snaps}
+        for key in list(self.store.list_objects()):
+            try:
+                loc, _si = split_shard_key(key)
+                pool_id, _oid = split_loc(loc)
+            except ValueError:
+                continue
+            sid = snap_of_loc(loc)
+            if not sid:
+                continue
+            if sid not in live.get(pool_id, set()):
+                try:
+                    self.store.queue_transactions(
+                        Transaction().remove(key)
+                    )
+                except Exception:
+                    pass  # next tick retries
+
+    # -- watch / notify (librados watch/notify role) --------------------
+    def _op_watch(self, msg: OSDOp, conn) -> OSDOpReply:
+        """Register the sending connection as a watcher of the object
+        (cookie in msg.name). Soft state on the primary — a primary
+        change or daemon restart drops it, like the reference's watch
+        timeout forces re-watch."""
+        if conn is None:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=b"watch needs a connection",
+            )
+        with self._watch_lock:
+            self._watchers.setdefault(
+                (msg.pool, msg.oid), {}
+            )[msg.name] = conn
+        return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    def _op_unwatch(self, msg: OSDOp) -> OSDOpReply:
+        with self._watch_lock:
+            entry = self._watchers.get((msg.pool, msg.oid), {})
+            entry.pop(msg.name, None)
+        return OSDOpReply(msg.tid, self.osdmap.epoch)
+
+    def _op_notify(self, msg: OSDOp, client_oid: str) -> OSDOpReply:
+        """Fan the payload to every watcher, wait for acks (bounded),
+        reply with who acked / who timed out (notify_ack collection,
+        osd/Watch.cc role)."""
+        import json as _json
+
+        # client-supplied, but capped: one misbehaving notifier must
+        # not park this reader thread forever
+        timeout = min((msg.length / 1000.0) if msg.length else 1.0, 30.0)
+        with self._watch_lock:
+            watchers = dict(self._watchers.get((msg.pool, msg.oid), {}))
+            notify_id = self._next_notify_id
+            self._next_notify_id += 1
+        if not watchers:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch,
+                data=_json.dumps({"acked": [], "missed": []}).encode(),
+            )
+        ev = threading.Event()
+        state = {"pending": set(watchers), "acked": []}
+        with self._watch_lock:
+            self._pending_notifies[notify_id] = (state, ev)
+        dead = []
+        for cookie, wconn in watchers.items():
+            try:
+                wconn.send(WatchNotify(
+                    notify_id, cookie, msg.pool, client_oid, msg.data
+                ))
+            except Exception:
+                dead.append(cookie)
+        if dead:
+            with self._watch_lock:
+                for cookie in dead:
+                    state["pending"].discard(cookie)
+                    self._watchers.get(
+                        (msg.pool, msg.oid), {}
+                    ).pop(cookie, None)
+                if not state["pending"]:
+                    ev.set()
+        ev.wait(timeout)
+        with self._watch_lock:
+            self._pending_notifies.pop(notify_id, None)
+            acked = list(state["acked"])
+            missed = sorted(state["pending"])
+        return OSDOpReply(
+            msg.tid, self.osdmap.epoch,
+            data=_json.dumps(
+                {"acked": sorted(acked), "missed": missed}
+            ).encode(),
+        )
+
+    def _handle_notify_ack(self, msg) -> None:
+        with self._watch_lock:
+            entry = self._pending_notifies.get(msg.notify_id)
+            if entry is None:
+                return
+            state, ev = entry
+            if msg.cookie in state["pending"]:
+                state["pending"].discard(msg.cookie)
+                state["acked"].append(msg.cookie)
+            if not state["pending"]:
+                ev.set()
+
     def _op_pgls(self, msg, spec, pgid: int):
         """List one PG's objects (the PGLS op behind rados ls). The
         primary's own scan suffices when its acting set is whole
@@ -1105,7 +1435,13 @@ class OSDDaemon:
                     spec.pool_id, spec.pg_num, pgid
                 )
             }
-        oids = sorted(split_loc(loc)[1] for loc in locs)
+        # snapshot clones are internal objects: they backfill and
+        # scrub, but never list (rados ls shows heads only)
+        oids = sorted(
+            split_loc(loc)[1]
+            for loc in locs
+            if not snap_of_loc(loc)
+        )
         return OSDOpReply(
             msg.tid, self.osdmap.epoch,
             data=_json.dumps(oids).encode(),
@@ -1276,6 +1612,7 @@ class OSDDaemon:
         self._adopt_pg_temps()
         self._maybe_gc_pools()
         self._maybe_schedule_scrubs()
+        self._gc_dropped_snaps()
 
     # -- background scrub scheduler (osd/scrubber/osd_scrub.cc role) ----
     def _scrub_due(

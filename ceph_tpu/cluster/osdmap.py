@@ -88,6 +88,10 @@ class PoolSpec:
     distinct_zones: bool = False
     #: named crush rule (OSDMap.crush_rules); empty = flat straw2
     crush_rule: str = ""
+    #: pool snapshots: ((snapid, name, created_epoch), ...) ascending
+    #: (pg_pool_t snaps); snap_seq is the next id to issue
+    snaps: tuple[tuple[int, str, int], ...] = ()
+    snap_seq: int = 0
 
     @property
     def size(self) -> int:
@@ -110,6 +114,8 @@ class PoolSpec:
             "plugin": self.plugin,
             "distinct_zones": self.distinct_zones,
             "crush_rule": self.crush_rule,
+            "snaps": [list(s) for s in self.snaps],
+            "snap_seq": self.snap_seq,
         }
 
     @classmethod
@@ -118,6 +124,8 @@ class PoolSpec:
             o["name"], o["pool_id"], o["pg_num"], o["profile_name"],
             o["k"], o["m"], o["plugin"], o["distinct_zones"],
             o.get("crush_rule", ""),
+            tuple(tuple(s) for s in o.get("snaps", ())),
+            o.get("snap_seq", 0),
         )
 
 

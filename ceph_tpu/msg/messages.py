@@ -34,6 +34,8 @@ MSG_PG_LIST = 116             # backfill object discovery
 MSG_PG_LIST_REPLY = 117
 MSG_GET_ATTRS = 118           # per-shard attr fetch (scrub consensus)
 MSG_GET_ATTRS_REPLY = 119
+MSG_WATCH_NOTIFY = 120        # MWatchNotify (daemon -> watcher push)
+MSG_NOTIFY_ACK = 121          # watcher ack back to the primary
 
 VERSION = 1
 
@@ -228,6 +230,9 @@ class OSDOp:
     #: stable across resends (osd_reqid_t analog): the primary dedups
     #: re-applied mutations by replaying the completed op's result
     reqid: str = ""
+    #: snapshot id a read targets (0 = head); the primary resolves
+    #: the clone (rados_ioctx_snap_set_read role)
+    snap: int = 0
 
     def encode(self) -> list[bytes]:
         return [
@@ -243,6 +248,7 @@ class OSDOp:
                     "length": self.length,
                     "name": self.name,
                     "reqid": self.reqid,
+                    "snap": self.snap,
                 },
             ),
             self.data,
@@ -254,7 +260,7 @@ class OSDOp:
         return cls(
             h["tid"], h["epoch"], h["pool"], h["oid"], h["op"],
             h["offset"], h["length"], segments[1], h.get("name", ""),
-            h.get("reqid", ""),
+            h.get("reqid", ""), h.get("snap", 0),
         )
 
 
@@ -432,6 +438,63 @@ def serve_get_attrs(store, shard_id: int, conn, msg: "GetAttrs") -> None:
         conn.send(GetAttrsReply(msg.tid, shard_id, error="enoent"))
 
 
+@dataclass
+class WatchNotify:
+    """Primary -> watcher event push (MWatchNotify,
+    src/messages/MWatchNotify.h): carries the notify payload to every
+    registered watcher of the object; the watcher answers with
+    NotifyAck so the notifier learns who saw it."""
+
+    notify_id: int
+    cookie: str   # the watcher's registration cookie
+    pool: str
+    oid: str
+    payload: bytes = b""
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "watch_notify",
+                {
+                    "notify_id": self.notify_id,
+                    "cookie": self.cookie,
+                    "pool": self.pool,
+                    "oid": self.oid,
+                },
+            ),
+            self.payload,
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "WatchNotify":
+        h = _parse(segments[0], "watch_notify")
+        return cls(
+            h["notify_id"], h["cookie"], h["pool"], h["oid"],
+            segments[1],
+        )
+
+
+@dataclass
+class NotifyAck:
+    """Watcher -> primary completion of one notify delivery."""
+
+    notify_id: int
+    cookie: str
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "notify_ack",
+                {"notify_id": self.notify_id, "cookie": self.cookie},
+            ),
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "NotifyAck":
+        h = _parse(segments[0], "notify_ack")
+        return cls(h["notify_id"], h["cookie"])
+
+
 _DECODERS = {
     MSG_EC_SUB_WRITE: ECSubWrite.decode,
     MSG_EC_SUB_WRITE_REPLY: ECSubWriteReply.decode,
@@ -445,6 +508,8 @@ _DECODERS = {
     MSG_PG_LIST_REPLY: PGListReply.decode,
     MSG_GET_ATTRS: GetAttrs.decode,
     MSG_GET_ATTRS_REPLY: GetAttrsReply.decode,
+    MSG_WATCH_NOTIFY: WatchNotify.decode,
+    MSG_NOTIFY_ACK: NotifyAck.decode,
 }
 
 _TYPE_OF = {
@@ -460,6 +525,8 @@ _TYPE_OF = {
     PGListReply: MSG_PG_LIST_REPLY,
     GetAttrs: MSG_GET_ATTRS,
     GetAttrsReply: MSG_GET_ATTRS_REPLY,
+    WatchNotify: MSG_WATCH_NOTIFY,
+    NotifyAck: MSG_NOTIFY_ACK,
 }
 
 
