@@ -984,12 +984,21 @@ class OSDDaemon:
         (OSD::enqueue_op -> mClock queue -> dequeue_op, osd/OSD.cc:
         9874,9933). Cost scales with payload so a large write consumes
         proportionally more of the class's rate."""
-        if msg.op in ("watch", "unwatch", "notify"):
-            # Watch plumbing runs on the READER thread, not the op
-            # worker: a notify waits for acks (which arrive on OTHER
-            # connections' readers), and parking the single worker on
-            # it would freeze every queued read/write on this primary.
+        if msg.op in ("watch", "unwatch"):
+            # quick registry flips: reader thread, no queueing
             self._run_client_op(conn, msg)
+            return
+        if msg.op == "notify":
+            # A notify WAITS for acks. Not on the worker (it would
+            # freeze all queued IO) and not on this reader either —
+            # when the notifier also watches the object over this
+            # same connection, its own ack arrives HERE and a parked
+            # reader would deadlock against itself. Own short-lived
+            # thread.
+            threading.Thread(
+                target=self._run_client_op, args=(conn, msg),
+                name="notify", daemon=True,
+            ).start()
             return
         cost = 1.0 + max(len(msg.data), msg.length) / 65536.0
         self._schedule(
@@ -1308,7 +1317,7 @@ class OSDDaemon:
         )
         if state == getattr(self, "_snap_state_swept", None):
             return
-        self._snap_state_swept = state
+        swept_clean = True
         live: dict[int, set[int]] = {}
         for spec in self.osdmap.pools.values():
             live[spec.pool_id] = {s[0] for s in spec.snaps}
@@ -1327,7 +1336,12 @@ class OSDDaemon:
                         Transaction().remove(key)
                     )
                 except Exception:
-                    pass  # next tick retries
+                    swept_clean = False  # keep the sweep armed
+        if swept_clean:
+            # only a FULLY clean sweep disarms: a failed removal (or
+            # an exception above) leaves the state mismatch in place
+            # so the next tick rescans
+            self._snap_state_swept = state
 
     # -- watch / notify (librados watch/notify role) --------------------
     def _op_watch(self, msg: OSDOp, conn) -> OSDOpReply:

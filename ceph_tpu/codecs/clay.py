@@ -36,6 +36,8 @@ TPU-first deltas from the reference:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 
@@ -51,6 +53,31 @@ from .registry import registry
 
 def _pow_int(a: int, x: int) -> int:
     return a**x
+
+
+@functools.lru_cache(maxsize=256)
+def _mul_table_np(c: int) -> np.ndarray:
+    """[256] uint8 host table for GF mul-by-constant ``c``. The cache
+    holds NUMPY only — caching a device array built inside a jit
+    trace would leak that trace's tracer into every later call
+    (UnexpectedTracerError); jnp.asarray at the call site turns it
+    into a per-trace constant instead."""
+    return np.array(
+        [gf_mul_bytes(c, np.array([v], np.uint8))[0] for v in range(256)],
+        np.uint8,
+    )
+
+
+def _gf_mul_traced(c: int, x):
+    import jax.numpy as jnp
+
+    if c == 0:
+        return jnp.zeros_like(x)
+    if c == 1:
+        return x
+    return jnp.take(
+        jnp.asarray(_mul_table_np(c)), x.astype(jnp.int32)
+    )
 
 
 class ClayCodec(ErasureCodeBase):
@@ -148,12 +175,14 @@ class ClayCodec(ErasureCodeBase):
     def _pair_solve(
         self,
         known: tuple[int, int],
-        a: np.ndarray,
-        b: np.ndarray,
+        a,
+        b,
         want: int,
-    ) -> np.ndarray:
+    ):
         c0, c1 = self._pair_coeffs(known, want)
-        return gf_mul_bytes(c0, a) ^ gf_mul_bytes(c1, b)
+        if isinstance(a, np.ndarray):
+            return gf_mul_bytes(c0, a) ^ gf_mul_bytes(c1, b)
+        return _gf_mul_traced(c0, a) ^ _gf_mul_traced(c1, b)
 
     def _pair_idx(self, x: int, x_other: int) -> tuple[int, int]:
         """(C index, U index) of the member with coordinate ``x`` in the
@@ -465,6 +494,14 @@ class ClayCodec(ErasureCodeBase):
         ``chunks`` maps helper chunk id -> the CONCATENATED repair
         sub-chunks selected by minimum_to_decode (in plane order).
         Returns the full lost chunk.
+
+        The whole body is TRACE-GENERIC: numpy inputs run the host
+        path with in-place updates; jax inputs (or tracers) build a
+        single functional device program — ``jax.jit`` over a fixed
+        erasure pattern turns repair into ONE dispatch, which is what
+        makes batched MSR repair usable through a remote-device
+        tunnel (round-3; the plane planning is all static Python
+        either way).
         """
         if len(want_to_read) != 1 or len(chunks) != self.d:
             raise ValueError(
@@ -474,37 +511,63 @@ class ClayCodec(ErasureCodeBase):
         lost_node = self._to_node(lost)
         q, t, n = self.q, self.t, self.q * self.t
 
+        # Traced ONLY under an enclosing jit (tracer inputs): the
+        # functional device program then compiles to one dispatch.
+        # Eager callers — including the read pipeline handing over
+        # concrete jax arrays — keep the host path (coerce to numpy):
+        # an UN-jitted run of the traced body would be hundreds of
+        # per-op device round trips, the exact cost this split exists
+        # to avoid. Mixed input dicts are normalized either way.
+        traced = any(
+            isinstance(v, jax.core.Tracer) for v in chunks.values()
+        )
+        if traced:
+            import jax.numpy as jnp
+
+            zeros = jnp.zeros
+            chunks = {i: jnp.asarray(v) for i, v in chunks.items()}
+        else:
+            zeros = np.zeros
+            chunks = {i: np.asarray(v) for i, v in chunks.items()}
+
+        def setz(arr, z, val):
+            """arr[..., z, :] = val, in-place (host) or functional."""
+            if traced:
+                return arr.at[..., z, :].set(val)
+            arr[..., z, :] = val
+            return arr
+
         repair_planes: list[int] = []
         for index, count in self.get_repair_subchunks(lost_node):
             repair_planes.extend(range(index, index + count))
         plane_ind = {z: i for i, z in enumerate(repair_planes)}
         r = len(repair_planes)
 
-        sample = np.asarray(next(iter(chunks.values())))
+        sample = next(iter(chunks.values()))
         if sample.shape[-1] % r:
             raise ValueError(
                 f"helper bytes {sample.shape[-1]} not divisible by "
                 f"{r} repair planes"
             )
         sc = sample.shape[-1] // r
-        lead = sample.shape[:-1]
+        lead = tuple(sample.shape[:-1])
         helper = {}
         aloof = set()
         for chunk_id in range(self.k + self.m):
             node = self._to_node(chunk_id)
             if chunk_id in chunks:
                 helper[node] = (
-                    np.asarray(chunks[chunk_id])
+                    chunks[chunk_id]
                     .reshape(lead + (r, sc))
                     .astype(np.uint8)
                 )
             elif chunk_id != lost:
                 aloof.add(node)
         for i in range(self.k, self.k + self.nu):
-            helper[i] = np.zeros(lead + (r, sc), np.uint8)
+            helper[i] = zeros(lead + (r, sc), np.uint8)
 
-        recovered = np.zeros(lead + (self.sub_chunk_no, sc), np.uint8)
-        U = {i: np.zeros(lead + (self.sub_chunk_no, sc), np.uint8)
+        recovered = zeros(lead + (self.sub_chunk_no, sc), np.uint8)
+        U = {i: zeros(lead + (self.sub_chunk_no, sc), np.uint8)
              for i in range(n)}
 
         # Erasures for the uncoupled decode: the lost node's whole
@@ -549,26 +612,27 @@ class ClayCodec(ErasureCodeBase):
                         if node_sw in aloof:
                             # U_xy from (C_xy, U_sw) — U_sw was decoded
                             # in an earlier (lower-order) plane group.
-                            U[node][..., z, :] = self._pair_solve(
+                            U[node] = setz(U[node], z, self._pair_solve(
                                 (node_c, sw_u),
                                 helper[node][..., plane_ind[z], :],
                                 U[node_sw][..., z_sw, :],
                                 node_u,
-                            )
+                            ))
                         elif z_vec[y] != x:
                             # Both coupled values are helper data.
-                            U[node][..., z, :] = self._pair_solve(
+                            U[node] = setz(U[node], z, self._pair_solve(
                                 (node_c, sw_c),
                                 helper[node][..., plane_ind[z], :],
                                 helper[node_sw][..., plane_ind[z_sw], :],
                                 node_u,
-                            )
+                            ))
                         else:
-                            U[node][..., z, :] = helper[node][
-                                ..., plane_ind[z], :
-                            ]
+                            U[node] = setz(
+                                U[node], z,
+                                helper[node][..., plane_ind[z], :],
+                            )
             # Batched uncoupled decode over this order group.
-            self._repair_decode_batch(erasures, planes, U, sc, lead)
+            self._repair_decode_batch(erasures, planes, U, sc, lead, traced)
             # Convert: recover coupled values of the lost chunk.
             for z in planes:
                 z_vec = self._plane_vector(z)
@@ -580,7 +644,9 @@ class ClayCodec(ErasureCodeBase):
                     z_sw = self._z_sw(z, x, y, z_vec)
                     if x == z_vec[y]:
                         if node == lost_node:
-                            recovered[..., z, :] = U[node][..., z, :]
+                            recovered = setz(
+                                recovered, z, U[node][..., z, :]
+                            )
                     else:
                         # Helper member of the lost row: its coupled
                         # (helper) value plus its U give the LOST
@@ -589,25 +655,25 @@ class ClayCodec(ErasureCodeBase):
                             raise AssertionError("unexpected repair pair")
                         node_c, node_u = self._pair_idx(x, z_vec[y])
                         lost_c, _ = self._pair_idx(z_vec[y], x)
-                        recovered[..., z_sw, :] = self._pair_solve(
+                        recovered = setz(recovered, z_sw, self._pair_solve(
                             (node_c, node_u),
                             helper[node][..., plane_ind[z], :],
                             U[node][..., z, :],
                             lost_c,
-                        )
+                        ))
+        out = recovered.reshape(lead + (self.sub_chunk_no * sc,))
         return {
-            lost: jax.numpy.asarray(
-                recovered.reshape(lead + (self.sub_chunk_no * sc,))
-            )
+            lost: out if traced else jax.numpy.asarray(out)
         }
 
     def _repair_decode_batch(
         self,
         erasures: set[int],
         planes: list[int],
-        U: dict[int, np.ndarray],
+        U: dict,
         sc: int,
         lead: tuple,
+        traced: bool = False,
     ) -> None:
         import jax.numpy as jnp
 
@@ -620,7 +686,10 @@ class ClayCodec(ErasureCodeBase):
         }
         out = self.mds.decode_chunks(set(erasures), known)
         for node in erasures:
-            U[node][..., zsel, :] = np.asarray(out[node])
+            if traced:
+                U[node] = U[node].at[..., zsel, :].set(out[node])
+            else:
+                U[node][..., zsel, :] = np.asarray(out[node])
 
 
 registry.register("clay", ClayCodec, PLUGIN_ABI_VERSION)

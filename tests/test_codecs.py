@@ -194,3 +194,45 @@ def test_chunk_size_alignment():
     lib = create_codec("jerasure", technique="liberation", k=4, m=2, w=7)
     cs = lib.get_chunk_size(4 * 1000)
     assert cs % (7 * 128) == 0
+
+
+def test_clay_repair_traced_matches_numpy(rng):
+    """The trace-generic repair body: jax-array helpers under jit
+    produce the numpy path's bytes exactly (one device program — the
+    round-3 tunnel-latency fix)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.codecs.registry import registry
+
+    codec = registry.factory("clay", {"k": "4", "m": "2", "d": "5"})
+    k, n = 4, 6
+    chunk = codec.get_chunk_size(k * 2048)
+    sub = codec.get_sub_chunk_count()
+    sc = chunk // sub
+    data = {
+        i: rng.integers(0, 256, (2, chunk), np.uint8) for i in range(k)
+    }
+    chunks = {
+        **data,
+        **{i: np.asarray(v) for i, v in codec.encode_chunks(data).items()},
+    }
+    for lost in (1, k + 1):
+        plan = codec.minimum_to_decode({lost}, set(range(n)) - {lost})
+        helper = {}
+        for node, ranges in plan.items():
+            parts = [
+                chunks[node][..., idx * sc : (idx + cnt) * sc]
+                for idx, cnt in ranges
+            ]
+            helper[node] = np.concatenate(parts, axis=-1)
+        ref = np.asarray(codec.repair({lost}, helper)[lost])
+        np.testing.assert_array_equal(ref, chunks[lost])
+        keys = sorted(helper)
+        fn = jax.jit(
+            lambda *arrs: codec.repair(
+                {lost}, dict(zip(keys, arrs))
+            )[lost]
+        )
+        got = np.asarray(fn(*[jnp.asarray(helper[kk]) for kk in keys]))
+        np.testing.assert_array_equal(got, ref)
