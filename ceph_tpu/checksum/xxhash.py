@@ -35,6 +35,16 @@ def _rotl32(x: jax.Array, r: int) -> jax.Array:
     return (x << r) | (x >> (32 - r))
 
 
+def _unroll_factor(nsteps: int, cap: int = 16) -> int:
+    """Largest divisor of ``nsteps`` <= cap: the scan runs
+    nsteps/f steps with f rounds unrolled per step — the per-step
+    scan overhead (tiny [B, 4] bodies) dominated the whole kernel."""
+    for f in range(min(cap, nsteps), 0, -1):
+        if nsteps % f == 0:
+            return f
+    return 1
+
+
 def _le32(b: jax.Array) -> jax.Array:
     """[..., 4] uint8 -> [...] uint32 little-endian."""
     w = b.astype(jnp.uint32)
@@ -53,20 +63,30 @@ def xxh32_kernel(
     i = 0
     if n >= 16:
         nstripes = n // 16
-        stripes = _le32(
-            data[:, : nstripes * 16].reshape(bsz, nstripes, 4, 4)
-        )  # [B, S, 4] uint32 lanes
         init = jnp.broadcast_to(
             jnp.stack([seed + p1 + p2, seed + p2, seed, seed - p1]),
             (bsz, 4),
         )
+        f = _unroll_factor(nstripes)
+        # Keep the scanned operand in BYTES ([G, B, f*16] uint8) and
+        # build the uint32 lanes inside the body: pre-materializing
+        # _le32 over the whole input wrote a 4x-expanded uint32
+        # tensor (plus its transpose) through HBM — 5x the kernel's
+        # true traffic and the actual bottleneck.
+        grouped = (
+            data[:, : nstripes * 16]
+            .reshape(bsz, nstripes // f, f * 16)
+            .swapaxes(0, 1)
+        )
 
-        def body(acc, lanes):  # lanes [B, 4]
-            acc = acc + lanes * p2
-            acc = _rotl32(acc, 13) * p1
+        def body(acc, group):  # group [B, f*16] uint8
+            lanes = _le32(group.reshape(bsz, f, 4, 4))  # [B, f, 4]
+            for j in range(f):
+                acc = acc + lanes[:, j] * p2
+                acc = _rotl32(acc, 13) * p1
             return acc, None
 
-        acc, _ = jax.lax.scan(body, init, stripes.swapaxes(0, 1))
+        acc, _ = jax.lax.scan(body, init, grouped)
         h = (
             _rotl32(acc[:, 0], 1)
             + _rotl32(acc[:, 1], 7)
@@ -118,8 +138,6 @@ def xxh64_kernel(
     i = 0
     if n >= 32:
         nstripes = n // 32
-        lanes = data[:, : nstripes * 32].reshape(bsz, nstripes, 4, 8)
-        hi, lo = _le64_pair(lanes)  # each [B, S, 4]
         init4 = [
             u64.add(seed, u64.add(p1, p2)),
             u64.add(seed, p2),
@@ -132,12 +150,23 @@ def xxh64_kernel(
             jnp.stack([a[1] for a in init4], axis=-1),  # lo [B, 4]
         )
 
-        def body(acc, lane):  # acc/lane: (hi, lo) [B, 4]
-            return _xxh64_round(acc, lane), None
-
-        acc, _ = jax.lax.scan(
-            body, init, (hi.swapaxes(0, 1), lo.swapaxes(0, 1))
+        f = _unroll_factor(nstripes)
+        # bytes stay bytes until inside the body (see xxh32_kernel)
+        grouped = (
+            data[:, : nstripes * 32]
+            .reshape(bsz, nstripes // f, f * 32)
+            .swapaxes(0, 1)
         )
+
+        def body(acc, group):  # group [B, f*32] uint8
+            hi, lo = _le64_pair(
+                group.reshape(bsz, f, 4, 8)
+            )  # each [B, f, 4]
+            for j in range(f):
+                acc = _xxh64_round(acc, (hi[:, j], lo[:, j]))
+            return acc, None
+
+        acc, _ = jax.lax.scan(body, init, grouped)
         accs = [(acc[0][:, j], acc[1][:, j]) for j in range(4)]
         h = u64.add(
             u64.add(u64.rotl(accs[0], 1), u64.rotl(accs[1], 7)),
