@@ -35,14 +35,14 @@ def _rotl32(x: jax.Array, r: int) -> jax.Array:
     return (x << r) | (x >> (32 - r))
 
 
-def _unroll_factor(nsteps: int, cap: int = 16) -> int:
-    """Largest divisor of ``nsteps`` <= cap: the scan runs
-    nsteps/f steps with f rounds unrolled per step — the per-step
-    scan overhead (tiny [B, 4] bodies) dominated the whole kernel."""
-    for f in range(min(cap, nsteps), 0, -1):
-        if nsteps % f == 0:
-            return f
-    return 1
+def _unroll_split(nsteps: int, cap: int = 16) -> tuple[int, int]:
+    """(f, main): the scan runs ``main // f`` steps with ``f`` rounds
+    unrolled per step (per-step scan overhead on tiny [B, 4] bodies
+    dominated the whole kernel); the ``nsteps - main`` remainder
+    stripes run eagerly after the scan. No divisibility requirement —
+    a prime stripe count must not fall back to the 1-per-step cliff."""
+    f = min(cap, nsteps)
+    return f, (nsteps // f) * f
 
 
 def _le32(b: jax.Array) -> jax.Array:
@@ -67,15 +67,15 @@ def xxh32_kernel(
             jnp.stack([seed + p1 + p2, seed + p2, seed, seed - p1]),
             (bsz, 4),
         )
-        f = _unroll_factor(nstripes)
+        f, main = _unroll_split(nstripes)
         # Keep the scanned operand in BYTES ([G, B, f*16] uint8) and
         # build the uint32 lanes inside the body: pre-materializing
         # _le32 over the whole input wrote a 4x-expanded uint32
         # tensor (plus its transpose) through HBM — 5x the kernel's
         # true traffic and the actual bottleneck.
         grouped = (
-            data[:, : nstripes * 16]
-            .reshape(bsz, nstripes // f, f * 16)
+            data[:, : main * 16]
+            .reshape(bsz, main // f, f * 16)
             .swapaxes(0, 1)
         )
 
@@ -87,6 +87,12 @@ def xxh32_kernel(
             return acc, None
 
         acc, _ = jax.lax.scan(body, init, grouped)
+        for s in range(main, nstripes):  # remainder stripes, eager
+            lanes = _le32(
+                data[:, s * 16 : (s + 1) * 16].reshape(bsz, 4, 4)
+            )
+            acc = acc + lanes * p2
+            acc = _rotl32(acc, 13) * p1
         h = (
             _rotl32(acc[:, 0], 1)
             + _rotl32(acc[:, 1], 7)
@@ -150,11 +156,11 @@ def xxh64_kernel(
             jnp.stack([a[1] for a in init4], axis=-1),  # lo [B, 4]
         )
 
-        f = _unroll_factor(nstripes)
+        f, main = _unroll_split(nstripes)
         # bytes stay bytes until inside the body (see xxh32_kernel)
         grouped = (
-            data[:, : nstripes * 32]
-            .reshape(bsz, nstripes // f, f * 32)
+            data[:, : main * 32]
+            .reshape(bsz, main // f, f * 32)
             .swapaxes(0, 1)
         )
 
@@ -167,6 +173,11 @@ def xxh64_kernel(
             return acc, None
 
         acc, _ = jax.lax.scan(body, init, grouped)
+        for s in range(main, nstripes):  # remainder stripes, eager
+            hi, lo = _le64_pair(
+                data[:, s * 32 : (s + 1) * 32].reshape(bsz, 4, 8)
+            )
+            acc = _xxh64_round(acc, (hi, lo))
         accs = [(acc[0][:, j], acc[1][:, j]) for j in range(4)]
         h = u64.add(
             u64.add(u64.rotl(accs[0], 1), u64.rotl(accs[1], 7)),
