@@ -197,10 +197,17 @@ def cmd_osd_tree(cl: Cluster, args) -> int:
             "in" if info.in_ else "out"
         )
         addr = f"{info.addr[0]}:{info.addr[1]}" if info.addr else "-"
-        print(
-            f"osd.{osd}\tweight {info.weight:.2f}\tzone "
-            f"{info.zone or '-'}\t{state}\t{addr}"
+        where = (
+            " ".join(f"{t}={b}" for t, b in info.location)
+            or (f"zone {info.zone}" if info.zone else "-")
         )
+        print(
+            f"osd.{osd}\tweight {info.weight:.2f}\t{where}\t"
+            f"{state}\t{addr}"
+        )
+    for name, steps in sorted(m.crush_rules.items()):
+        rendered = "; ".join(" ".join(str(x) for x in s) for s in steps)
+        print(f"rule {name}: {rendered}")
     return 0
 
 
@@ -215,10 +222,33 @@ def cmd_pool_create(cl: Cluster, args) -> int:
     cl.mon.osd_pool_create(
         args.name, args.pg_num, args.profile,
         distinct_zones=args.distinct_zones,
+        failure_domain=args.failure_domain,
     )
     spec = cl.mon.osdmap.pools[args.name]
+    rule = f", rule {spec.crush_rule!r}" if spec.crush_rule else ""
     print(f"pool {args.name!r} created: EC {spec.k}+{spec.m}, "
-          f"{spec.pg_num} pgs")
+          f"{spec.pg_num} pgs{rule}")
+    return 0
+
+
+def cmd_snap(cl: Cluster, args) -> int:
+    """pool snapshots: create / rm / ls (rados mksnap/rmsnap/lssnap)."""
+    if args.action in ("create", "rm") and not args.snap:
+        print(f"snap {args.action} needs a snap name")
+        return 1
+    if args.action == "create":
+        cl.mon.osd_pool_snap_create(args.pool, args.snap)
+        print(f"created pool snap {args.snap!r} on {args.pool!r}")
+    elif args.action == "rm":
+        cl.mon.osd_pool_snap_rm(args.pool, args.snap)
+        print(f"removed pool snap {args.snap!r} from {args.pool!r}")
+    else:  # ls
+        spec = cl.mon.osdmap.pools.get(args.pool)
+        if spec is None:
+            print(f"no such pool: {args.pool!r}")
+            return 1
+        for sid, name, epoch in spec.snaps:
+            print(f"{sid}\t{name}\t(epoch {epoch})")
     return 0
 
 
@@ -482,7 +512,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("pg_num", type=int)
     s.add_argument("profile", nargs="?", default="")
     s.add_argument("--distinct-zones", action="store_true")
+    s.add_argument(
+        "--failure-domain", default="",
+        help="spread shards across this bucket type (host/rack/...) "
+             "via an auto-created crush rule",
+    )
     s.set_defaults(fn=cmd_pool_create)
+
+    s = sub.add_parser(
+        "snap", help="pool snapshots (rados mksnap/rmsnap/lssnap)"
+    )
+    s.add_argument("action", choices=["create", "rm", "ls"])
+    s.add_argument("pool")
+    s.add_argument("snap", nargs="?", default="")
+    s.set_defaults(fn=cmd_snap)
 
     for name, fn, extra in (
         ("put", cmd_put, ["pool", "oid", "file"]),

@@ -70,14 +70,16 @@ class TestDeviceKernels:
         for i in range(2):
             assert got[i] == crc32c_ref(0, data[i].tobytes())
 
-    @pytest.mark.parametrize("block", [16, 48, 4096, 4099])
+    # 272 = 17 stripes (prime): exercises the eager remainder-stripe
+    # path after the unrolled scan
+    @pytest.mark.parametrize("block", [16, 48, 272, 4096, 4099])
     def test_xxh32_device_matches_ref(self, rng, block):
         data = rng.integers(0, 256, (3, block)).astype(np.uint8)
         got = np.asarray(xxh32_device(data, 0))
         for i in range(3):
             assert got[i] == xxh32_ref(data[i].tobytes())
 
-    @pytest.mark.parametrize("block", [32, 96, 4096, 4100, 4101])
+    @pytest.mark.parametrize("block", [32, 96, 544, 4096, 4100, 4101])
     def test_xxh64_device_matches_ref(self, rng, block):
         data = rng.integers(0, 256, (3, block)).astype(np.uint8)
         hi, lo = xxh64_device(data, 0)

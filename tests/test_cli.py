@@ -275,3 +275,18 @@ class TestMonStoreKV:
             pool_id_floor=store.pool_id_floor(),
         )
         assert mon._next_pool_id > 5  # the dead pool's id is burned
+
+
+def test_cli_pool_snapshots(cdir, capsys):
+    """snap create/ls/rm drive the pool-snapshot surface (rados
+    mksnap/lssnap/rmsnap role), persisted across CLI invocations."""
+    run(capsys, "-d", cdir, "vstart", "--osds", "4")
+    run(capsys, "-d", cdir, "profile-set", "snapprof",
+        "plugin=isa", "k=2", "m=1")
+    run(capsys, "-d", cdir, "pool-create", "snappl", "8", "snapprof")
+    run(capsys, "-d", cdir, "snap", "create", "snappl", "s1")
+    out = run(capsys, "-d", cdir, "snap", "ls", "snappl")
+    assert "s1" in out
+    run(capsys, "-d", cdir, "snap", "rm", "snappl", "s1")
+    out = run(capsys, "-d", cdir, "snap", "ls", "snappl")
+    assert "s1" not in out
