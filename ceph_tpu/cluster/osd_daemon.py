@@ -204,6 +204,7 @@ class _PGBackend:
         osd = self.acting[shard]
         if osd == SHARD_NONE:
             return  # parked: recovery's problem once the shard returns
+        loc = txn.oids()[0] if txn.oids() else ""
         txn = Transaction(
             ops=[
                 _dc_replace(op, oid=shard_key(op.oid, shard))
@@ -211,6 +212,17 @@ class _PGBackend:
             ]
         )
         if osd == self.daemon.osd_id:
+            # the primary's own shard goes through handle_sub_write
+            # too: ECInject write type 3 aborts it like any receiver
+            # (ECBackend.cc:922-926 fires on every OSD, primary
+            # included). Remote shards consult in _dispatch instead.
+            from ceph_tpu.pipeline.inject import ec_inject
+
+            if ec_inject.test_write_error3(loc):
+                threading.Thread(
+                    target=self.daemon.stop, daemon=True
+                ).start()
+                return
             self.daemon.local.submit_shard_txn(self.daemon.osd_id, txn, ack)
         else:
             self.daemon.peers.submit_shard_txn(osd, txn, ack)
@@ -286,6 +298,15 @@ class _PG:
         )
         # writes stamp (epoch, tid) eversions into OI attrs
         self.rmw.epoch = daemon.osdmap.epoch
+        # ECInject write type 2: the primary marks ITSELF down via the
+        # mon command when the final sub-write commit arrives
+        # (ECBackend.cc:1158-1167). Async: osd_down propagates the map
+        # to every daemon synchronously, which must not run under the
+        # ack path's locks.
+        self.rmw.on_osd_down_inject = lambda: threading.Thread(
+            target=lambda: daemon.monitor.osd_down(daemon.osd_id),
+            daemon=True,
+        ).start()
         self.reads = ReadPipeline(
             self.sinfo, self.codec, self.backend,
             lambda oid: daemon._object_size(self, oid),
@@ -463,6 +484,7 @@ class OSDDaemon:
         if self._stopped:
             return
         to_recover: list[tuple[_PG, list[int]]] = []
+        to_release: list[tuple[_PG, list[int]]] = []
         with self._pg_lock:
             if osdmap.epoch < self.osdmap.epoch:
                 return  # late delivery from a racing notifier thread
@@ -536,14 +558,29 @@ class OSDDaemon:
                     i for i, osd in enumerate(new_acting)
                     if osd != SHARD_NONE and pg.acting[i] == SHARD_NONE
                 ]
+                downed = [
+                    i for i, osd in enumerate(new_acting)
+                    if osd == SHARD_NONE and pg.acting[i] != SHARD_NONE
+                ]
                 pg.acting[:] = new_acting
                 pg.backend.acting[:] = new_acting
                 pg.backend.recovering.update(healed)
+                pg.backend.recovering.difference_update(downed)
+                if downed:
+                    to_release.append((pg, downed))
                 if healed:
                     to_recover.append((pg, healed))
         # drive recovery OUTSIDE the pg lock on worker threads: a
         # born-hole refresh is O(objects in PG) of network IO, and this
         # callback runs on the monitor's notify path
+        # a member that died with sub-write acks outstanding must not
+        # wedge in-flight ops behind the op timeout: release its acks
+        # (extents stay dirty in the pg log). OUTSIDE _pg_lock — the
+        # release may dispatch the next queued op, whose RMW backend
+        # read blocks on the messenger.
+        for pg, downed in to_release:
+            for i in downed:
+                pg.rmw.on_shard_down(i)
         for pg, healed in to_recover:
             for shard in healed:
                 threading.Thread(
@@ -922,6 +959,18 @@ class OSDDaemon:
         if isinstance(msg, Ping):
             conn.send(Pong(msg.tid, self.osd_id))
         elif isinstance(msg, ECSubWrite):
+            oids = msg.txn.oids()
+            loc = split_shard_key(oids[0])[0] if oids else ""
+            from ceph_tpu.pipeline.inject import ec_inject
+
+            if ec_inject.test_write_error3(loc):
+                # ECInject write type 3: handle_sub_write aborts the
+                # OSD (ceph_abort, ECBackend.cc:922-926). The write is
+                # never applied, the ack never sent; heartbeats and the
+                # mon take it from here. Stop on a side thread — stop()
+                # joins the worker/messenger threads this may run on.
+                threading.Thread(target=self.stop, daemon=True).start()
+                return
             self.local.submit_shard_txn(
                 self.osd_id,
                 msg.txn,

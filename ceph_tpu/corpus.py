@@ -35,8 +35,11 @@ DEFAULT_SUITE: list[tuple[str, dict[str, str]]] = [
     ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "reed_sol_van", "k": "8", "m": "4"}),
     ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_orig", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "liberation", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liber8tion", "k": "4", "m": "2"}),
     ("isa", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
     ("isa", {"technique": "cauchy", "k": "4", "m": "2"}),
     ("lrc", {"k": "4", "m": "2", "l": "3"}),

@@ -11,7 +11,17 @@ exactly where the reference hooks ``handle_sub_read`` /
 - write type 0: the client write op fails before dispatch (abort).
 - write type 1: the sub-write to a shard is silently dropped — the ack
   never arrives, leaving the op parked in the in-order commit queue
-  (the rollback-forcing inject of the reference).
+  (the rollback-forcing inject of the reference). Firing auto-arms a
+  type-2 inject on the same object, exactly as the reference does
+  (ECInject.cc test_write_error1 → write_error(o, 2, 0, 1)).
+- write type 2: "inject OSD down" — consulted on the primary when the
+  final sub-write commit arrives (pending_commits == 1 in
+  handle_sub_write_reply, ECBackend.cc:1158-1167); the primary marks
+  itself down via the mon-command analog.
+- write type 3: "write abort OSDs" — consulted in handle_sub_write
+  (ECBackend.cc:922-926); the receiving OSD aborts (``ceph_abort``),
+  so the write is never applied and the ack never arrives. The
+  reference requires duration == 1 for this type.
 
 Each injection has ``when`` (ops to let through first) and ``duration``
 (ops to affect) counters, matching the reference's tell-command
@@ -25,6 +35,18 @@ import threading
 from dataclasses import dataclass
 
 ANY_SHARD = -1
+
+
+def _base_oid(oid: str) -> str:
+    """Strip a per-shard store-key suffix (``<oid>#s<n>``, the
+    ghobject shard_id field) — object-wide rules (write types 2/3) are
+    keyed by the base object, the way the reference normalizes
+    ghobject→NO_SHARD before touching write_failures2/3
+    (ECInject.cc test_write_error2/3)."""
+    loc, sep, s = oid.rpartition("#s")
+    if sep and s.isdigit():
+        return loc
+    return oid
 
 
 @dataclass
@@ -71,8 +93,15 @@ class ECInject:
         self, oid: str, type: int, when: int = 0, duration: int = 1,
         shard: int = ANY_SHARD,
     ) -> str:
-        if type not in (0, 1):
+        if type not in (0, 1, 2, 3):
             return "unrecognized error inject type"
+        if type == 3 and duration != 1:
+            # the reference refuses multi-shot OSD aborts
+            # (ECInject.cc write_error case 3)
+            return "duration must be 1"
+        if type in (2, 3):
+            shard = ANY_SHARD  # registered object-wide, never per-shard
+            oid = _base_oid(oid)
         with self._lock:
             self._rules[("write", type, oid, shard)] = _Rule(when, duration)
         return f"ok: write error type {type} on {oid}"
@@ -120,7 +149,21 @@ class ECInject:
         return self._test("write", 0, oid, ANY_SHARD)
 
     def test_write_error1(self, oid: str, shard: int) -> bool:
-        return self._test("write", 1, oid, shard)
+        fired = self._test("write", 1, oid, shard)
+        if fired:
+            # a dropped sub-write arms an OSD-down inject on the same
+            # object (ECInject.cc test_write_error1): the next commit
+            # cycle takes the primary down, forcing the rollback path.
+            # Keyed by the BASE object — the consult site passes the
+            # client oid, not the per-shard store key.
+            self.write_error(_base_oid(oid), 2, 0, 1)
+        return fired
+
+    def test_write_error2(self, oid: str) -> bool:
+        return self._test("write", 2, _base_oid(oid), ANY_SHARD)
+
+    def test_write_error3(self, oid: str) -> bool:
+        return self._test("write", 3, _base_oid(oid), ANY_SHARD)
 
 
 # The process-global registry, mirroring the reference's namespace-level

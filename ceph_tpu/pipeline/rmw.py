@@ -27,6 +27,7 @@ classic OSD's thread pools.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from collections.abc import Callable
@@ -191,6 +192,7 @@ class ClientOp:
         self.plan: WritePlan | None = None
         self.cache_op: CacheOp | None = None
         self.pending_shards: set[int] = set()
+        self.acked_shards: set[int] = set()
         self.written: "ShardExtentMap | None" = None
         self.committed = False
         self.notified = False
@@ -285,6 +287,13 @@ class ShardBackend:
         from .inject import ec_inject
 
         oid = txn.oids()[0] if txn.oids() else ""
+        if ec_inject.test_write_error3(oid):
+            # ECInject write type 3: the receiving OSD aborts in
+            # handle_sub_write (ECBackend.cc:922-926). In-process
+            # analog: the shard's OSD dies — nothing applies, no ack,
+            # and the shard drops out of the acting set.
+            self.down_shards.add(shard)
+            return
         if ec_inject.test_write_error1(oid, shard):
             return  # sub-write silently dropped: ack never arrives
         self.stores[shard].queue_transactions(txn)
@@ -342,6 +351,16 @@ class RMWPipeline:
         #: oid -> backend-read failure awaiting its op (degraded RMW
         #: read failed; the op aborts in _cache_ready, in order)
         self._read_errors: dict[str, Exception] = {}
+        #: ECInject write-type-2 seam: the owning daemon points this at
+        #: its "mark me down" mon command (ECBackend.cc:1158-1167);
+        #: standalone pipelines leave it None
+        self.on_osd_down_inject: Callable[[], None] | None = None
+        #: serializes ack/commit bookkeeping: sub-write acks arrive on
+        #: messenger pump threads while map changes release dead
+        #: shards' acks from the monitor-notify thread — both mutate
+        #: pending_shards/_inflight. Reentrant: a local synchronous
+        #: dispatch acks inside submit, and on_commit may re-enter.
+        self._ack_lock = threading.RLock()
         from ceph_tpu.utils import PerfCountersBuilder, perf_collection
 
         self.perf = (
@@ -710,12 +729,78 @@ class RMWPipeline:
             )
 
     def _shard_ack(self, op: ClientOp, shard: int) -> None:
-        if self.pglog is not None:
-            self.pglog.ack(shard, op.tid)
-        op.pending_shards.discard(shard)
-        if not op.pending_shards:
-            op.committed = True
+        finish = False
+        with self._ack_lock:
+            if len(op.pending_shards) == 1 and shard in op.pending_shards:
+                # final sub-write reply for this op: the reference
+                # consults ECInject write type 2 here (pending_commits
+                # == 1 in handle_sub_write_reply, ECBackend.cc:1158-
+                # 1167) and, if armed, has the primary mark ITSELF
+                # down via mon command. Hook check FIRST: where no
+                # down-hook exists the armed rule must not be consumed
+                # to no effect.
+                from .inject import ec_inject
+
+                if self.on_osd_down_inject is not None and (
+                    ec_inject.test_write_error2(op.oid)
+                ):
+                    self.on_osd_down_inject()
+            if self.pglog is not None:
+                self.pglog.ack(shard, op.tid)
+            op.pending_shards.discard(shard)
+            op.acked_shards.add(shard)
+            if not op.pending_shards and not op.committed:
+                op.committed = True
+                finish = True
+        # cache release OUTSIDE the ack lock: write_done may dispatch
+        # the next queued op for this object, whose RMW backend read
+        # blocks on the messenger — IO must never run under _ack_lock
+        # (ABBA with the reply-pump thread's _shard_ack)
+        if finish:
             self.cache.write_done(op.cache_op, op.written)
+            with self._ack_lock:
+                self._check_commit_order()
+
+    def on_shard_down(self, shard: int) -> None:
+        """An acting member died with sub-write acks outstanding: those
+        acks will never arrive. Commit parked ops on the surviving set
+        — the mirror of the hole-journaling ``_dispatch_writes``
+        applies when the member is already down at dispatch time. The
+        pg log is NOT acked for the dead shard, so its missed extents
+        stay dirty for delta recovery when it returns (the reference
+        requeues the op into the new interval; the client's resend
+        dedups via reqid).
+
+        Durability floor: an op may only report success if at least k
+        shards actually acked — the same min_size floor
+        ``_generate_transactions`` enforces at dispatch. Below that the
+        new stripe cannot be decoded (survivors mix old and new
+        chunks), so the op completes with an error instead."""
+        finished: list[ClientOp] = []
+        with self._ack_lock:
+            for op in list(self._inflight.values()):
+                if shard in op.pending_shards:
+                    op.pending_shards.discard(shard)
+                    if not op.pending_shards and not op.committed:
+                        if len(op.acked_shards) < self.sinfo.k:
+                            op.error = IOError(
+                                f"write lost below min_size: only "
+                                f"{len(op.acked_shards)} of {self.sinfo.k}"
+                                f" required shards durable"
+                            )
+                            self.perf.inc("aborts")
+                        op.committed = True
+                        finished.append(op)
+        # cache release outside _ack_lock (see _shard_ack). A failed
+        # op publishes an EMPTY map, exactly like _abort_op: the cache
+        # must not serve bytes the client was told were lost.
+        for op in finished:
+            self.cache.write_done(
+                op.cache_op,
+                op.written if op.error is None
+                else ShardExtentMap(self.sinfo),
+            )
+        with self._ack_lock:
             self._check_commit_order()
 
     def on_shard_recovered(
@@ -725,6 +810,12 @@ class RMWPipeline:
         treat the lost sub-write acks as durable and let parked ops
         commit — the rollforward of partially-committed EC writes
         (pending_roll_forward semantics, ECCommon.h:500-503 + PGLog)."""
+        with self._ack_lock:
+            self._on_shard_recovered_locked(shard, up_to_tid)
+
+    def _on_shard_recovered_locked(
+        self, shard: int, up_to_tid: int | None
+    ) -> None:
         for tid, op in list(self._inflight.items()):
             if up_to_tid is not None and tid > up_to_tid:
                 continue

@@ -637,3 +637,54 @@ def test_secure_mode_cluster_end_to_end():
         client.shutdown()
         for d in daemons:
             d.stop()
+
+
+def test_device_dispatch_route_end_to_end():
+    """The socket-cluster tier drives the DEVICE codec route, not just
+    host GF tables (VERDICT r3 weak #6): with the host small-op
+    shortcut disabled, a write + degraded read must move the
+    ``ec_dispatch`` einsum counters — proving cluster-tier dispatch
+    and the device engine are actually integrated."""
+    from ceph_tpu.codecs.matrix_codec import _dispatch_counters
+    from ceph_tpu.utils import config
+
+    def snap():
+        pc = _dispatch_counters()
+        return {k: pc.get(k) for k in pc.dump()}
+
+    mon = Monitor()
+    daemons = []
+    for i in range(5):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+    for i in range(5):
+        d = OSDDaemon(i, mon, chunk_size=4096)
+        d.start()
+        daemons.append(d)
+    mon.osd_erasure_code_profile_set(
+        "rsdev", {"plugin": "isa", "k": "3", "m": "2"}
+    )
+    mon.osd_pool_create("devpool", 4, "rsdev")
+    client = RadosClient(mon, backoff=0.01)
+    config.set("ec_host_dispatch_bytes", 0)
+    try:
+        before = snap()
+        io = client.open_ioctx("devpool")
+        data = payload(3 * 4096 * 2)          # two full stripes
+        io.write("obj", data)
+        victim = mon.osdmap.object_to_acting("devpool", "obj")[1]
+        daemons[victim].stop()
+        mon.osd_down(victim)
+        assert io.read("obj") == data          # reconstruct read
+        after = snap()
+        assert after["einsum_encode"] > before["einsum_encode"], (
+            "cluster write never reached the device encode route"
+        )
+        assert after["einsum_decode"] > before["einsum_decode"], (
+            "degraded cluster read never reached the device decode route"
+        )
+        assert after["host_encode"] == before["host_encode"]
+    finally:
+        config.rm("ec_host_dispatch_bytes")
+        client.shutdown()
+        for d in daemons:
+            d.stop()

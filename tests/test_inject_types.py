@@ -1,0 +1,224 @@
+"""ECInject write types 2/3 — the OSD-down / OSD-abort injects.
+
+Reference semantics (osd/ECInject.{h,cc}, osd/ECBackend.cc):
+- type 2 "inject OSD down": consulted on the primary when the final
+  sub-write commit arrives (pending_commits == 1 in
+  handle_sub_write_reply, ECBackend.cc:1158-1167); the primary marks
+  itself down via mon command.
+- type 3 "write abort OSDs": consulted in handle_sub_write
+  (ECBackend.cc:922-926); the receiving OSD aborts. duration must be 1.
+- a type-1 fire (dropped sub-write) auto-arms type 2 on the object
+  (ECInject.cc test_write_error1).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.pipeline.inject import ec_inject
+from ceph_tpu.pipeline.pglog import PGLog
+from ceph_tpu.pipeline.recovery import RecoveryBackend
+from ceph_tpu.pipeline.rmw import RMWPipeline, ShardBackend
+from ceph_tpu.pipeline.stripe import PAGE_SIZE, StripeInfo
+from ceph_tpu.store import MemStore
+
+K, M = 4, 2
+CHUNK = PAGE_SIZE
+
+
+@pytest.fixture(autouse=True)
+def clean_inject():
+    ec_inject.clear_all()
+    yield
+    ec_inject.clear_all()
+
+
+def make_stack():
+    sinfo = StripeInfo(K, M, K * CHUNK)
+    codec = registry.factory(
+        "jerasure", {"technique": "reed_sol_van", "k": str(K), "m": str(M)}
+    )
+    backend = ShardBackend({s: MemStore(f"osd.{s}") for s in range(K + M)})
+    pglog = PGLog(K + M)
+    rmw = RMWPipeline(sinfo, codec, backend, pglog=pglog)
+    rec = RecoveryBackend(sinfo, codec, backend, rmw.object_size, rmw.hinfo)
+    return rmw, rec, pglog, sinfo, codec, backend
+
+
+class TestRegistry:
+    def test_type3_requires_duration_one(self):
+        assert ec_inject.write_error("o", 3, duration=2) == (
+            "duration must be 1"
+        )
+        assert ec_inject.write_error("o", 3, duration=1).startswith("ok")
+
+    def test_unknown_type_rejected(self):
+        assert ec_inject.write_error("o", 4) == (
+            "unrecognized error inject type"
+        )
+        assert ec_inject.read_error("o", 2) == (
+            "unrecognized error inject type"
+        )
+
+    def test_types_2_3_are_object_wide(self):
+        # shard arg is ignored for 2/3 (reference registers them with
+        # NO_SHARD): a rule armed "per shard" still fires object-wide
+        ec_inject.write_error("o", 3, shard=5)
+        assert ec_inject.test_write_error3("o")
+
+    def test_types_2_3_normalize_shard_keys(self):
+        # ghobject→NO_SHARD normalization: a consult with the
+        # per-shard store key ("<oid>#s<n>") matches the base-object
+        # rule, and vice versa — the daemon tier consults with either
+        ec_inject.write_error("0:obj", 2)
+        assert ec_inject.test_write_error2("0:obj#s3")
+        ec_inject.write_error("0:obj#s1", 3)
+        assert ec_inject.test_write_error3("0:obj")
+
+    def test_type1_arm_normalizes_to_base_object(self):
+        # a type-1 drop consulted with the sharded store key (the
+        # daemon-tier sub-write path) must arm type 2 under the BASE
+        # oid, where the commit-path consult looks for it
+        ec_inject.write_error("0:obj#s2", 1, shard=2)
+        assert ec_inject.test_write_error1("0:obj#s2", 2)
+        assert ec_inject.test_write_error2("0:obj")
+
+
+class TestPipelineTier:
+    def test_type3_aborts_receiving_shard(self, rng):
+        """The first shard to receive the sub-write dies: txn not
+        applied, no ack, shard leaves the available set; the op parks
+        and rolls forward once the shard is recovered."""
+        rmw, rec, pglog, sinfo, codec, backend = make_stack()
+        data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+        rmw.submit("obj", 0, data)
+        ec_inject.write_error("obj", 3)
+        committed = []
+        rmw.submit("obj", 0, data, lambda op: committed.append(op.tid))
+        assert committed == []          # parked: one ack never came
+        assert len(backend.down_shards) == 1   # the aborted "OSD"
+        victim = next(iter(backend.down_shards))
+        assert ec_inject.injected_count == 1
+        # log-driven catch-up + rollforward commits the parked op
+        backend.down_shards.clear()
+        rec.recover_from_log(pglog, victim)
+        rmw.on_shard_recovered(victim)
+        assert committed == [2]
+
+    def test_type2_fires_on_final_commit(self, rng):
+        rmw, *_ = make_stack()
+        fired = []
+        rmw.on_osd_down_inject = lambda: fired.append(True)
+        ec_inject.write_error("obj", 2)
+        data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+        committed = []
+        rmw.submit("obj", 0, data, lambda op: committed.append(op.tid))
+        assert committed == [1]         # the write itself commits
+        assert fired == [True]          # ... and the primary goes down
+        # one-shot: the next write does not re-fire
+        rmw.submit("obj", 0, data)
+        assert fired == [True]
+
+    def test_type1_fire_arms_type2(self, rng):
+        """Reference chaining: a dropped sub-write arms an OSD-down
+        inject, consumed when the parked op finally commits."""
+        rmw, rec, pglog, sinfo, codec, backend = make_stack()
+        fired = []
+        rmw.on_osd_down_inject = lambda: fired.append(True)
+        data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+        rmw.submit("obj", 0, data)
+        ec_inject.write_error("obj", 1, duration=1, shard=2)
+        committed = []
+        rmw.submit("obj", 0, data, lambda op: committed.append(op.tid))
+        assert committed == [] and fired == []
+        rec.recover_from_log(pglog, 2)
+        rmw.on_shard_recovered(2)       # final ack for the parked op
+        assert committed == [2]
+        assert fired == [True], "type-1 fire must arm a type-2 inject"
+
+
+def _payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8
+    ).tobytes()
+
+
+class TestDaemonTier:
+    @pytest.fixture
+    def cluster(self):
+        from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+
+        mon = Monitor()
+        daemons = []
+        for i in range(6):
+            mon.osd_crush_add(i, zone=f"z{i % 3}")
+        for i in range(6):
+            d = OSDDaemon(i, mon, chunk_size=1024)
+            d.start()
+            daemons.append(d)
+        mon.osd_erasure_code_profile_set(
+            "rs32", {"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "3", "m": "2"}
+        )
+        mon.osd_pool_create("ecpool", 8, "rs32")
+        client = RadosClient(mon, backoff=0.01)
+        yield mon, daemons, client
+        client.shutdown()
+        for d in daemons:
+            d.stop()
+
+    def test_type3_kills_replica_daemon(self, cluster):
+        """A sub-write receiver aborts mid-write; once failure handling
+        marks it down, the client write completes degraded and reads
+        back intact."""
+        from ceph_tpu.cluster.osd_daemon import make_loc
+
+        mon, daemons, client = cluster
+        io = client.open_ioctx("ecpool")
+        pool_id = mon.osdmap.pools["ecpool"].pool_id
+        ec_inject.write_error(make_loc(pool_id, "obj"), 3)
+        data = _payload(5_000)
+        comp = io.aio_write("obj", data)
+        # one replica abort()s; collapse failure detection to the
+        # mon command the moment it lands (the e2e-tier convention)
+        end = time.monotonic() + 10
+        victim = None
+        while victim is None and time.monotonic() < end:
+            victim = next(
+                (d.osd_id for d in daemons if d._stopped), None
+            )
+            time.sleep(0.01)
+        assert victim is not None, "no daemon aborted"
+        mon.osd_down(victim)
+        # the first attempt may die with the aborted OSD's ack (the
+        # primary times the sub-write out to EIO); the workload-level
+        # retry — what the reference's QA harness does — must then
+        # land degraded
+        try:
+            comp.wait_for_complete(timeout=30)
+        except IOError:
+            io.write("obj", data)
+        assert io.read("obj") == data
+        assert ec_inject.injected_count >= 1
+
+    def test_type2_primary_marks_itself_down(self, cluster):
+        from ceph_tpu.cluster.osd_daemon import make_loc
+
+        mon, daemons, client = cluster
+        io = client.open_ioctx("ecpool")
+        pool_id = mon.osdmap.pools["ecpool"].pool_id
+        primary = mon.osdmap.primary("ecpool", "obj")
+        ec_inject.write_error(make_loc(pool_id, "obj"), 2)
+        data = _payload(4_000)
+        io.write("obj", data)           # commits, then self-down
+        end = time.monotonic() + 10
+        while mon.osdmap.is_up(primary) and time.monotonic() < end:
+            time.sleep(0.02)
+        assert not mon.osdmap.is_up(primary), (
+            "type-2 inject must take the primary down via the mon"
+        )
+        # the daemon itself is alive (marked down, not crashed): reads
+        # proceed through the failover primary
+        assert io.read("obj") == data

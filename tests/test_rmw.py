@@ -277,3 +277,47 @@ def test_cache_on_change_drops_state():
     assert cache.lru_size() >= 0
     cache.on_change()
     assert cache.lru_size() == 0
+
+
+class TestShardDownMidFlight:
+    """on_shard_down: a member dying with acks outstanding must not
+    wedge in-flight ops (the map change releases its acks), but may
+    only report success if >= k shards actually acked."""
+
+    def test_unwedges_parked_op_above_floor(self, rng):
+        pipe, sinfo, codec, backend = make_pipeline()
+        data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+        backend.defer_acks = True
+        committed = []
+        pipe.submit("obj", 0, data, lambda op: committed.append(op))
+        # all but shard 5 ack; shard 5 dies
+        for shard, ack in list(backend.deferred):
+            if shard != 5:
+                ack()
+        assert committed == []
+        backend.down_shards.add(5)
+        pipe.on_shard_down(5)
+        assert len(committed) == 1 and committed[0].error is None
+        # the dead shard's extents stay dirty for delta recovery
+        # (the log was never acked for it)
+        assert pipe.pglog is None  # standalone stack has no log here
+
+    def test_below_min_size_errors_instead_of_lying(self, rng):
+        pipe, sinfo, codec, backend = make_pipeline()
+        data = rng.integers(0, 256, K * CHUNK, np.uint8).tobytes()
+        # two members already down at dispatch: live == k exactly
+        backend.down_shards.update({0, 1})
+        backend.defer_acks = True
+        committed = []
+        pipe.submit("obj", 0, data, lambda op: committed.append(op))
+        # 3 of the 4 live shards ack, then the 4th dies: only 3 < k
+        # durable copies — success would be a lie the stripe can't
+        # decode its way out of
+        for shard, ack in list(backend.deferred):
+            if shard != 5:
+                ack()
+        backend.down_shards.add(5)
+        pipe.on_shard_down(5)
+        assert len(committed) == 1
+        assert committed[0].error is not None
+        assert "min_size" in str(committed[0].error)
