@@ -25,12 +25,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ceph_tpu.gf import gf_matrix_to_bitmatrix
 from ceph_tpu.gf.bitmatrix import bitmatrix_invert, bitmatrix_matmul
-from ceph_tpu.ops.bitplane import packet_mod2_apply
+from ceph_tpu.ops.bitplane import xor_bytes
 
 from .base import ErasureCodeBase
 from .interface import Flag
-from .matrix_codec import DecodeTableCache
+from .matrix_codec import (
+    BitplaneDispatchMixin,
+    DecodeTableCache,
+    _dispatch_counters,
+)
 
 
 def _shift(w: int, d: int) -> np.ndarray:
@@ -147,37 +152,45 @@ def gf2w_power_bitmatrix(k: int, w: int = 8) -> bytes:
     return coding.tobytes()
 
 
-@jax.jit
-def _apply_packets(bmat: jax.Array, packets: jax.Array) -> jax.Array:
-    return packet_mod2_apply(bmat, packets)
-
-
-class BitMatrixCodec(ErasureCodeBase):
+class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
     """Erasure codec driven by a [m*w, k*w] GF(2) coding matrix.
 
     Chunk layout: chunk = w consecutive packets of chunk_size/w bytes
     (the jerasure packet convention, with packetsize implied by chunk
     size rather than a separate profile knob — TPU tiling makes the
     packet the natural unit).
+
+    Engine note (round 4): a packet-selection XOR network IS a GF(2^8)
+    matrix apply whose matrix entries happen to be 0/1 — GF(2) is the
+    subfield {0,1} of GF(2^8), so the packet matrix routes through the
+    SAME dispatch engine as the byte codes (host GF tables / mesh /
+    Pallas MXU kernel / einsum, with ec_dispatch counters), the way
+    the reference funnels both jerasure_matrix_encode and
+    jerasure_schedule_encode into one plugin hot path.
     """
 
     def __init__(self) -> None:
         super().__init__()
         self.w = 0
         self.coding_bitmatrix: np.ndarray | None = None  # [m*w, k*w]
-        self._device_bmat: jax.Array | None = None
-        self._tables = DecodeTableCache()
+        self._tables = DecodeTableCache()       # device matrices
+        self._host_tables = DecodeTableCache()  # packet 0/1 matrices
 
     def _set_bitmatrix(self, coding: np.ndarray) -> None:
         assert coding.shape == (self.m * self.w, self.k * self.w)
         self.coding_bitmatrix = coding.astype(np.uint8)
-        self._device_bmat = jnp.asarray(self.coding_bitmatrix)
+        # the packet matrix as a GF(2^8) 0/1 byte matrix, expanded to
+        # bit-plane form for the device engine (kron with I8)
+        self._encode_bmat_np = gf_matrix_to_bitmatrix(self.coding_bitmatrix)
+        self._encode_bmat = jnp.asarray(self._encode_bmat_np)
 
     def get_flags(self) -> Flag:
         return (
             Flag.OPTIMIZED_SUPPORTED
             | Flag.ZERO_INPUT_ZERO_OUTPUT
             | Flag.ZERO_PADDING_EXPECTED
+            | Flag.PARITY_DELTA_OPTIMIZATION
+            | Flag.PARITY_DELTA_CHUNK_GRANULARITY
         )
 
     def get_chunk_size(self, stripe_width: int) -> int:
@@ -198,12 +211,49 @@ class BitMatrixCodec(ErasureCodeBase):
         *lead, sw, p = packets.shape
         return packets.reshape(*lead, sw // self.w, p * self.w)
 
+    def _apply_packet_matrix(
+        self,
+        mat01: np.ndarray,
+        stacked: jax.Array,
+        op: str,
+        tables: "tuple[np.ndarray, jax.Array] | None" = None,
+    ) -> jax.Array:
+        """Apply a packet-level 0/1 matrix to [..., S, N] chunks via
+        the shared engine: packetize, route (host / mesh / Pallas /
+        einsum), de-packetize. ``tables`` passes precomputed
+        bit-expanded forms (the encode path keeps them resident)."""
+        packets = self._to_packets(stacked)
+        if not self._mesh_routable(packets) and self._host_sized(packets):
+            from ceph_tpu.gf import gf_apply_bytes_host
+
+            _dispatch_counters().inc(f"host_{op}")
+            out = gf_apply_bytes_host(mat01, np.asarray(packets))
+        else:
+            bm_np, bm_dev = tables or self._device_tables(mat01)
+            out = self._dispatch_bitmatrix(bm_np, bm_dev, packets, op)
+        return self._to_chunks(out)
+
+    def _device_tables(self, mat01: np.ndarray):
+        def build():
+            bm = gf_matrix_to_bitmatrix(mat01)
+            return bm, jnp.asarray(bm)
+
+        return self._tables.get(("bits", mat01.tobytes()), build)
+
+    @staticmethod
+    def _stack(vals: list) -> "np.ndarray | jax.Array":
+        if all(isinstance(v, np.ndarray) for v in vals):
+            return np.stack(vals, axis=-2)
+        return jnp.stack(vals, axis=-2)
+
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
-        stacked = self._stack_data(data)
-        parity = self._to_chunks(
-            _apply_packets(self._device_bmat, self._to_packets(stacked))
+        parity = self._apply_packet_matrix(
+            self.coding_bitmatrix,
+            self._stack_data(data),
+            "encode",
+            tables=(self._encode_bmat_np, self._encode_bmat),
         )
         return {self.k + i: parity[..., i, :] for i in range(self.m)}
 
@@ -217,17 +267,50 @@ class BitMatrixCodec(ErasureCodeBase):
         if not want:
             return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
-        bmat = self._tables.get(
+        dec01 = self._host_tables.get(
             key, lambda: self._build_decode_bitmatrix(present, want)
         )
-        stacked = jnp.stack([chunks[i] for i in present], axis=-2)
-        out = self._to_chunks(
-            _apply_packets(bmat, self._to_packets(stacked))
-        )
+        stacked = self._stack([chunks[i] for i in present])
+        out = self._apply_packet_matrix(dec01, stacked, "decode")
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, wshard in enumerate(want):
             result[wshard] = out[..., idx, :]
         return result
+
+    # -- parity delta (RMW) -------------------------------------------
+    def encode_delta(
+        self, old_data: jax.Array, new_data: jax.Array
+    ) -> jax.Array:
+        return xor_bytes(old_data, new_data)
+
+    def apply_delta(
+        self,
+        delta: dict[int, jax.Array],
+        parity: dict[int, jax.Array],
+    ) -> dict[int, jax.Array]:
+        """parity'_j = parity_j XOR (packet-matrix columns of the
+        changed chunks applied to the delta packets) — the
+        schedule_apply_delta analog (ErasureCodeJerasure.h:110-119).
+
+        Delta buffers must be whole chunks (the codec sets
+        PARITY_DELTA_CHUNK_GRANULARITY): a sub-chunk write's parity
+        update scatters across the entire chunk through the packet
+        structure, so the pipeline hands in chunk-aligned windows.
+        """
+        cols = sorted(delta)
+        w = self.w
+        pcols = [c * w + t for c in cols for t in range(w)]
+        mat01 = np.ascontiguousarray(self.coding_bitmatrix[:, pcols])
+        stacked = self._stack([delta[c] for c in cols])
+        contrib = self._apply_packet_matrix(mat01, stacked, "delta")
+        out = {}
+        for pid, p in parity.items():
+            c = contrib[..., pid - self.k, :]
+            if isinstance(p, np.ndarray) and isinstance(c, np.ndarray):
+                out[pid] = np.bitwise_xor(p, c)
+            else:
+                out[pid] = xor_bytes(p, c)
+        return out
 
     def _build_decode_bitmatrix(
         self, present: list[int], want: list[int]
@@ -280,4 +363,6 @@ class BitMatrixCodec(ErasureCodeBase):
             for a in range(self.w):
                 for b, r in enumerate(chosen):
                     dec[wi * self.w + a, col_of[r]] = comp[a, b]
-        return jnp.asarray(dec)
+        # host 0/1 matrix — cached in _host_tables and consumed by
+        # both routes (the device route bit-expands via _device_tables)
+        return dec

@@ -69,15 +69,53 @@ def _mul_table_np(c: int) -> np.ndarray:
 
 
 def _gf_mul_traced(c: int, x):
+    """GF(2^8) multiply-by-constant as a shift/mask/xor chain (the
+    carry-less "peasant" ladder): ~8 fused VPU ops. Replaces the
+    256-entry ``jnp.take`` gather, which serializes on TPU — the
+    gather formulation measured 0.08 GB/s through the whole CLAY
+    repair; this chain is what makes the traced repair stream."""
     import jax.numpy as jnp
 
     if c == 0:
         return jnp.zeros_like(x)
     if c == 1:
         return x
-    return jnp.take(
-        jnp.asarray(_mul_table_np(c)), x.astype(jnp.int32)
+    acc = None
+    xt = x
+    cc = c
+    while cc:
+        if cc & 1:
+            acc = xt if acc is None else acc ^ xt
+        cc >>= 1
+        if cc:
+            hi = (xt >> jnp.uint8(7)).astype(jnp.uint8)
+            xt = ((xt << jnp.uint8(1)) ^ (hi * jnp.uint8(0x1D))).astype(
+                jnp.uint8
+            )
+    return acc
+
+
+def _gf_mul_vec_traced(cs: np.ndarray, x):
+    """Per-row GF constant multiply: ``x`` [P, ...], ``cs`` [P] uint8.
+    One 8-step shift/xor ladder over the WHOLE stack — this is the op
+    that lets a plane-group's pair transforms run as a single fused
+    dispatch instead of one kernel per (plane, node)."""
+    import jax.numpy as jnp
+
+    c = jnp.asarray(np.asarray(cs, np.uint8)).reshape(
+        (-1,) + (1,) * (x.ndim - 1)
     )
+    acc = jnp.zeros_like(x)
+    xt = x
+    for j in range(8):
+        bit = ((c >> jnp.uint8(j)) & jnp.uint8(1)).astype(jnp.uint8)
+        acc = acc ^ (xt * bit)
+        if j < 7:
+            hi = (xt >> jnp.uint8(7)).astype(jnp.uint8)
+            xt = ((xt << jnp.uint8(1)) ^ (hi * jnp.uint8(0x1D))).astype(
+                jnp.uint8
+            )
+    return acc
 
 
 class ClayCodec(ErasureCodeBase):
@@ -596,75 +634,178 @@ class ClayCodec(ErasureCodeBase):
 
         for o in sorted(ordered):
             planes = ordered[o]
-            for z in planes:
-                z_vec = self._plane_vector(z)
-                for y in range(t):
-                    for x in range(q):
-                        node = y * q + x
-                        if node in erasures:
-                            continue
-                        node_sw = y * q + z_vec[y]
-                        z_sw = self._z_sw(z, x, y, z_vec)
-                        # Tuple indices of this node and its companion
-                        # in the canonical (C_hi, C_lo, U_hi, U_lo).
-                        node_c, node_u = self._pair_idx(x, z_vec[y])
-                        sw_c, sw_u = self._pair_idx(z_vec[y], x)
-                        if node_sw in aloof:
-                            # U_xy from (C_xy, U_sw) — U_sw was decoded
-                            # in an earlier (lower-order) plane group.
-                            U[node] = setz(U[node], z, self._pair_solve(
-                                (node_c, sw_u),
-                                helper[node][..., plane_ind[z], :],
-                                U[node_sw][..., z_sw, :],
-                                node_u,
-                            ))
-                        elif z_vec[y] != x:
-                            # Both coupled values are helper data.
-                            U[node] = setz(U[node], z, self._pair_solve(
-                                (node_c, sw_c),
-                                helper[node][..., plane_ind[z], :],
-                                helper[node_sw][..., plane_ind[z_sw], :],
-                                node_u,
-                            ))
-                        else:
-                            U[node] = setz(
-                                U[node], z,
-                                helper[node][..., plane_ind[z], :],
-                            )
+            uitems, citems = self._plan_repair_group(
+                planes, erasures, aloof, lost_node
+            )
+            if traced:
+                self._exec_uitems_stacked(uitems, helper, U, plane_ind)
+            else:
+                for (node, z, c0, c1, asrc, bsrc) in uitems:
+                    a = self._item_slice(asrc, helper, U, plane_ind)
+                    if c1 == 0 and c0 == 1:
+                        U[node] = setz(U[node], z, a)
+                        continue
+                    b = self._item_slice(bsrc, helper, U, plane_ind)
+                    U[node] = setz(
+                        U[node], z,
+                        gf_mul_bytes(c0, a) ^ gf_mul_bytes(c1, b),
+                    )
             # Batched uncoupled decode over this order group.
             self._repair_decode_batch(erasures, planes, U, sc, lead, traced)
             # Convert: recover coupled values of the lost chunk.
-            for z in planes:
-                z_vec = self._plane_vector(z)
-                for node in sorted(erasures):
-                    if node in aloof:
+            if traced:
+                recovered = self._exec_citems_stacked(
+                    citems, helper, U, plane_ind, recovered
+                )
+            else:
+                for (zdst, c0, c1, asrc, bsrc) in citems:
+                    a = self._item_slice(asrc, helper, U, plane_ind)
+                    if c1 == 0 and c0 == 1:
+                        recovered = setz(recovered, zdst, a)
                         continue
-                    x, y = node % q, node // q
-                    node_sw = y * q + z_vec[y]
-                    z_sw = self._z_sw(z, x, y, z_vec)
-                    if x == z_vec[y]:
-                        if node == lost_node:
-                            recovered = setz(
-                                recovered, z, U[node][..., z, :]
-                            )
-                    else:
-                        # Helper member of the lost row: its coupled
-                        # (helper) value plus its U give the LOST
-                        # node's coupled value at the companion plane.
-                        if y != lost_node // q or node_sw != lost_node:
-                            raise AssertionError("unexpected repair pair")
-                        node_c, node_u = self._pair_idx(x, z_vec[y])
-                        lost_c, _ = self._pair_idx(z_vec[y], x)
-                        recovered = setz(recovered, z_sw, self._pair_solve(
-                            (node_c, node_u),
-                            helper[node][..., plane_ind[z], :],
-                            U[node][..., z, :],
-                            lost_c,
-                        ))
+                    b = self._item_slice(bsrc, helper, U, plane_ind)
+                    recovered = setz(
+                        recovered, zdst,
+                        gf_mul_bytes(c0, a) ^ gf_mul_bytes(c1, b),
+                    )
         out = recovered.reshape(lead + (self.sub_chunk_no * sc,))
         return {
             lost: out if traced else jax.numpy.asarray(out)
         }
+
+    # -- repair work-item planning + stacked execution -----------------
+    def _plan_repair_group(
+        self,
+        planes: list[int],
+        erasures: set[int],
+        aloof: set[int],
+        lost_node: int,
+    ):
+        """Static work items for one intersection-score group — ONE
+        source of truth for the pair algebra, executed either stacked
+        (traced device path) or element-at-a-time (host path).
+
+        U item:  (node, z, c0, c1, a_src, b_src): U[node][z] =
+                 c0*a ^ c1*b.
+        C item:  (z_dst, c0, c1, a_src, b_src): recovered[z_dst] = ...
+        src: ("h", node, z) helper packet at repair-plane z, or
+             ("u", node, z) U packet at absolute plane z.
+        """
+        q, t = self.q, self.t
+        uitems, citems = [], []
+        for z in planes:
+            z_vec = self._plane_vector(z)
+            for y in range(t):
+                for x in range(q):
+                    node = y * q + x
+                    if node in erasures:
+                        continue
+                    node_sw = y * q + z_vec[y]
+                    z_sw = self._z_sw(z, x, y, z_vec)
+                    # Tuple indices of this node and its companion in
+                    # the canonical (C_hi, C_lo, U_hi, U_lo).
+                    node_c, node_u = self._pair_idx(x, z_vec[y])
+                    sw_c, sw_u = self._pair_idx(z_vec[y], x)
+                    if node_sw in aloof:
+                        # U_xy from (C_xy, U_sw) — U_sw was decoded in
+                        # an earlier (lower-order) plane group.
+                        c0, c1 = self._pair_coeffs((node_c, sw_u), node_u)
+                        uitems.append((
+                            node, z, c0, c1,
+                            ("h", node, z), ("u", node_sw, z_sw),
+                        ))
+                    elif z_vec[y] != x:
+                        # Both coupled values are helper data.
+                        c0, c1 = self._pair_coeffs((node_c, sw_c), node_u)
+                        uitems.append((
+                            node, z, c0, c1,
+                            ("h", node, z), ("h", node_sw, z_sw),
+                        ))
+                    else:
+                        uitems.append((
+                            node, z, 1, 0,
+                            ("h", node, z), ("h", node, z),
+                        ))
+            for node in sorted(erasures):
+                if node in aloof:
+                    continue
+                x, y = node % q, node // q
+                node_sw = y * q + z_vec[y]
+                z_sw = self._z_sw(z, x, y, z_vec)
+                if x == z_vec[y]:
+                    if node == lost_node:
+                        citems.append((
+                            z, 1, 0, ("u", node, z), ("u", node, z)
+                        ))
+                else:
+                    # Helper member of the lost row: its coupled
+                    # (helper) value plus its U give the LOST node's
+                    # coupled value at the companion plane.
+                    if y != lost_node // q or node_sw != lost_node:
+                        raise AssertionError("unexpected repair pair")
+                    node_c, node_u = self._pair_idx(x, z_vec[y])
+                    lost_c, _ = self._pair_idx(z_vec[y], x)
+                    c0, c1 = self._pair_coeffs((node_c, node_u), lost_c)
+                    citems.append((
+                        z_sw, c0, c1, ("h", node, z), ("u", node, z)
+                    ))
+        return uitems, citems
+
+    @staticmethod
+    def _item_slice(src, helper, U, plane_ind):
+        kind, node, z = src
+        if kind == "h":
+            return helper[node][..., plane_ind[z], :]
+        return U[node][..., z, :]
+
+    def _exec_uitems_stacked(self, uitems, helper, U, plane_ind) -> None:
+        """All pair transforms of a plane group as ONE stacked
+        dispatch: [P, lead, sc] operand stacks, per-row constant GF
+        ladder, then grouped scatter back into U."""
+        import jax.numpy as jnp
+
+        if not uitems:
+            return
+        A = jnp.stack([
+            self._item_slice(a, helper, U, plane_ind)
+            for (_, _, _, _, a, _) in uitems
+        ])
+        B = jnp.stack([
+            self._item_slice(b, helper, U, plane_ind)
+            for (_, _, _, _, _, b) in uitems
+        ])
+        c0s = np.array([it[2] for it in uitems], np.uint8)
+        c1s = np.array([it[3] for it in uitems], np.uint8)
+        out = _gf_mul_vec_traced(c0s, A) ^ _gf_mul_vec_traced(c1s, B)
+        by_node: dict[int, list[int]] = {}
+        for idx, (node, *_rest) in enumerate(uitems):
+            by_node.setdefault(node, []).append(idx)
+        for node, idxs in by_node.items():
+            zs = np.array([uitems[i][1] for i in idxs])
+            sel = jnp.moveaxis(out[np.array(idxs)], 0, -2)
+            U[node] = U[node].at[..., zs, :].set(sel)
+
+    def _exec_citems_stacked(
+        self, citems, helper, U, plane_ind, recovered
+    ):
+        import jax.numpy as jnp
+
+        if not citems:
+            return recovered
+        A = jnp.stack([
+            self._item_slice(a, helper, U, plane_ind)
+            for (_, _, _, a, _) in citems
+        ])
+        B = jnp.stack([
+            self._item_slice(b, helper, U, plane_ind)
+            for (_, _, _, _, b) in citems
+        ])
+        c0s = np.array([it[1] for it in citems], np.uint8)
+        c1s = np.array([it[2] for it in citems], np.uint8)
+        out = _gf_mul_vec_traced(c0s, A) ^ _gf_mul_vec_traced(c1s, B)
+        zs = np.array([it[0] for it in citems])
+        sel = jnp.moveaxis(out, 0, -2)
+        return recovered.at[..., zs, :].set(sel)
 
     def _repair_decode_batch(
         self,

@@ -50,6 +50,14 @@ class Flag(enum.Flag):
     PARITY_DELTA_OPTIMIZATION = enum.auto()
     REQUIRE_SUB_CHUNKS = enum.auto()
     OPTIMIZED_SUPPORTED = enum.auto()
+    #: Parity-delta windows must be whole chunks: packet-layout codes
+    #: (liberation family) scatter a sub-chunk write's parity update
+    #: across the entire chunk through the packet structure — the
+    #: packetsize-granularity constraint of jerasure's
+    #: schedule_apply_delta (ErasureCodeJerasure.h:110-119). The
+    #: write planner chunk-aligns parity extents and the delta driver
+    #: hands the codec chunk-shaped buffers when this is set.
+    PARITY_DELTA_CHUNK_GRANULARITY = enum.auto()
 
 
 @runtime_checkable

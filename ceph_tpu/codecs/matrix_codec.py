@@ -98,44 +98,16 @@ class DecodeTableCache:
         return val
 
 
-class MatrixErasureCodec(ErasureCodeBase):
-    """Codec defined by a systematic (k+m) x k GF(2^8) generator matrix."""
-
-    def __init__(self) -> None:
-        super().__init__()
-        self.generator: np.ndarray | None = None  # [(k+m), k] uint8
-        self._encode_bmat: jax.Array | None = None
-        self._tables = DecodeTableCache()
-        self._host_tables = DecodeTableCache()  # byte matrices
-
-    # Subclasses set self.k/self.m then call this from init().
-    def _set_generator(self, generator: np.ndarray) -> None:
-        self.generator = np.asarray(generator, dtype=np.uint8)
-        assert self.generator.shape == (self.k + self.m, self.k)
-        self._encode_bmat_np = gf_matrix_to_bitmatrix(
-            self.generator[self.k :, :]
-        )
-        self._encode_bmat = jnp.asarray(self._encode_bmat_np)
-
-    def get_flags(self) -> Flag:
-        return (
-            Flag.OPTIMIZED_SUPPORTED
-            | Flag.PARITY_DELTA_OPTIMIZATION
-            | Flag.ZERO_INPUT_ZERO_OUTPUT
-            | Flag.ZERO_PADDING_EXPECTED
-            | Flag.PARTIAL_READ_OPTIMIZATION
-            | Flag.PARTIAL_WRITE_OPTIMIZATION
-        )
-
-    # -- encode -------------------------------------------------------
-    def encode_chunks(
-        self, data: dict[int, jax.Array]
-    ) -> dict[int, jax.Array]:
-        stacked = self._stack_data(data)
-        parity = self._encode_stacked(stacked)
-        return {
-            self.k + i: parity[..., i, :] for i in range(self.m)
-        }
+class BitplaneDispatchMixin:
+    """The device-dispatch engine shared by every bit-plane codec
+    family: route one bitmatrix application to host GF tables (small
+    numpy inputs), the mesh (when installed), the Pallas MXU kernel
+    (on TPU, tileable shapes), or the XLA einsum engine — with every
+    route visible in the ``ec_dispatch`` counters. The byte matrix
+    families (jerasure RS/Cauchy, ISA) and the packet bit-matrix
+    families (liberation/blaum_roth/liber8tion) both dispatch here;
+    the reference splits these across jerasure_matrix_encode vs
+    jerasure_schedule_encode, but on TPU they are one engine."""
 
     @staticmethod
     def _host_sized(*arrays) -> bool:
@@ -148,22 +120,6 @@ class MatrixErasureCodec(ErasureCodeBase):
             limit > 0
             and all(isinstance(a, np.ndarray) for a in arrays)
             and sum(a.nbytes for a in arrays) <= limit
-        )
-
-    def _encode_stacked(self, stacked: jax.Array) -> jax.Array:
-        """Dispatch the parity matmul: host GF tables for small numpy
-        inputs, the fused Pallas MXU kernel on TPU when the shape
-        tiles (config-gated), einsum otherwise. A mesh-routable shape
-        outranks the host shortcut (see _active_mesh)."""
-        if not self._mesh_routable(stacked) and self._host_sized(stacked):
-            from ceph_tpu.gf import gf_apply_bytes_host
-
-            _dispatch_counters().inc("host_encode")
-            return gf_apply_bytes_host(
-                self.generator[self.k :, :], stacked
-            )
-        return self._dispatch_bitmatrix(
-            self._encode_bmat_np, self._encode_bmat, stacked, "encode"
         )
 
     @staticmethod
@@ -237,6 +193,62 @@ class MatrixErasureCodec(ErasureCodeBase):
             _dispatch_counters().inc("pallas_fallback")
         _dispatch_counters().inc(f"einsum_{op}")
         return _apply_bitmatrix(bmat_dev, stacked)
+
+
+class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
+    """Codec defined by a systematic (k+m) x k GF(2^8) generator matrix."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.generator: np.ndarray | None = None  # [(k+m), k] uint8
+        self._encode_bmat: jax.Array | None = None
+        self._tables = DecodeTableCache()
+        self._host_tables = DecodeTableCache()  # byte matrices
+
+    # Subclasses set self.k/self.m then call this from init().
+    def _set_generator(self, generator: np.ndarray) -> None:
+        self.generator = np.asarray(generator, dtype=np.uint8)
+        assert self.generator.shape == (self.k + self.m, self.k)
+        self._encode_bmat_np = gf_matrix_to_bitmatrix(
+            self.generator[self.k :, :]
+        )
+        self._encode_bmat = jnp.asarray(self._encode_bmat_np)
+
+    def get_flags(self) -> Flag:
+        return (
+            Flag.OPTIMIZED_SUPPORTED
+            | Flag.PARITY_DELTA_OPTIMIZATION
+            | Flag.ZERO_INPUT_ZERO_OUTPUT
+            | Flag.ZERO_PADDING_EXPECTED
+            | Flag.PARTIAL_READ_OPTIMIZATION
+            | Flag.PARTIAL_WRITE_OPTIMIZATION
+        )
+
+    # -- encode -------------------------------------------------------
+    def encode_chunks(
+        self, data: dict[int, jax.Array]
+    ) -> dict[int, jax.Array]:
+        stacked = self._stack_data(data)
+        parity = self._encode_stacked(stacked)
+        return {
+            self.k + i: parity[..., i, :] for i in range(self.m)
+        }
+
+    def _encode_stacked(self, stacked: jax.Array) -> jax.Array:
+        """Dispatch the parity matmul: host GF tables for small numpy
+        inputs, the fused Pallas MXU kernel on TPU when the shape
+        tiles (config-gated), einsum otherwise. A mesh-routable shape
+        outranks the host shortcut (see _active_mesh)."""
+        if not self._mesh_routable(stacked) and self._host_sized(stacked):
+            from ceph_tpu.gf import gf_apply_bytes_host
+
+            _dispatch_counters().inc("host_encode")
+            return gf_apply_bytes_host(
+                self.generator[self.k :, :], stacked
+            )
+        return self._dispatch_bitmatrix(
+            self._encode_bmat_np, self._encode_bmat, stacked, "encode"
+        )
 
     # -- decode -------------------------------------------------------
     def decode_chunks(

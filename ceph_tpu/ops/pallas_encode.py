@@ -52,10 +52,15 @@ FOLD = 1               # retained for API compat; the v3 kernel ignores it
 
 
 def _pick_lane_tile(n: int) -> int:
-    """Largest power-of-two tile <= MAX_LANE_TILE dividing the chunk."""
+    """Largest LANE_TILE-multiple <= MAX_LANE_TILE dividing the chunk.
+
+    Not power-of-two halving: a 100 KiB chunk (the Cauchy baseline
+    config) divides 51200 but no power of two above 4096 — the old
+    halving search landed on a 4 KiB tile and paid 16x the grid-step
+    overhead."""
     t = MAX_LANE_TILE
     while t > LANE_TILE and n % t:
-        t //= 2
+        t -= LANE_TILE
     return t
 
 
@@ -114,12 +119,32 @@ def _v3_matrix_cached(
 
 
 def _pick_stripes(c: int, batch: int) -> tuple[int, int]:
-    """(stripes-per-block, pad-rows). Prefer the 128-contraction
-    two-stripe layout; otherwise one stripe with rows padded to the
-    int32 sublane-pack granularity (4)."""
+    """(stripes-per-block, pad-rows) — the high-k packing rule.
+
+    Measured on v5e (round 4, exp_highk*.py): column-stream rate is
+    roughly constant per F row-block up to F=32, so throughput tracks
+    useful bytes per streamed column. Winners per c:
+    - 2c <= 16 (flagship and below): two stripes, contraction 8*2c
+      (the round-3 layout, 305-333 GB/s at (8,4));
+    - c 9..12, even batch: two stripes padded to F=24 (210-299 GB/s
+      at k=10 vs 96 for the old single-stripe+pad fallback);
+    - c 13..16: one stripe padded to F=16 (708 GB/s at k=16);
+    - c 17..32: one stripe padded to F=32 (470 GB/s at k=21,
+      736 at k=32 — Mosaic tiles the 256-contraction cleanly);
+    - above 32: one stripe padded to the int32 sublane granularity
+      times two (F % 8 == 0), contraction tiled by the compiler.
+    """
     if batch % 2 == 0 and 2 * c <= 16 and (2 * c) % 4 == 0:
         return 2, 0
-    return 1, (-c) % 4
+    if c <= 8:
+        return 1, (-c) % 4
+    if batch % 2 == 0 and c <= 12:
+        return 2, (-2 * c) % 8
+    if c <= 16:
+        return 1, 16 - c
+    if c <= 32:
+        return 1, 32 - c
+    return 1, (-c) % 8
 
 
 # -------------------------------------------------------------- the kernel
@@ -267,10 +292,12 @@ def gf_encode_bitplane_pallas(
     tile = _pick_lane_tile(n)
     # VMEM pressure scales with the contraction width (8 * (S*C+pad)
     # int8 rows of bits plus the int32 accumulator); shrink the lane
-    # tile for wide matrices up front.
+    # tile for wide matrices up front. F <= 32 keeps the full 64K
+    # tile — measured FASTER there (k=32/F=32 at 64K ran 1.5x the
+    # shrunken tile); only genuinely wide contractions shrink.
     f = s * c + pad
-    if f > 16:
-        while tile > LANE_TILE and tile > (65536 * 16) // f:
+    if f > 32:
+        while tile > LANE_TILE and tile > (65536 * 32) // f:
             tile //= 2
     if isinstance(data, jax.core.Tracer):
         # Under an outer trace the compile happens later, outside any

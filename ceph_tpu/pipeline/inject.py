@@ -162,8 +162,15 @@ class ECInject:
     def test_write_error2(self, oid: str) -> bool:
         return self._test("write", 2, _base_oid(oid), ANY_SHARD)
 
-    def test_write_error3(self, oid: str) -> bool:
-        return self._test("write", 3, _base_oid(oid), ANY_SHARD)
+    def test_write_error3(self, oid: str, exact: bool = False) -> bool:
+        """``exact=True`` consults the rule under the oid as given (no
+        ghobject normalization) — the standalone pipeline tier uses it
+        so a rule the daemon tier already consulted (with the
+        normalized base oid) is not decremented a second time by the
+        nested ShardBackend hop."""
+        return self._test(
+            "write", 3, oid if exact else _base_oid(oid), ANY_SHARD
+        )
 
 
 # The process-global registry, mirroring the reference's namespace-level

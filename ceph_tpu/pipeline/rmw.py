@@ -115,6 +115,23 @@ def plan_write(
     """
     touched = sinfo.ro_range_to_shard_extent_set(ro_offset, length, parity=True)
     to_write = {s: es.align(4096) for s, es in touched.items()}
+    if flags & Flag.PARITY_DELTA_CHUNK_GRANULARITY:
+        # packet-layout codes scatter a sub-chunk write's parity
+        # update across the whole chunk: parity reads/writes must
+        # cover whole chunks so the delta driver can hand the codec
+        # chunk-shaped windows with the old parity present. Align to
+        # the CHUNK, exactly the widening encode_parity_delta applies
+        # — max(chunk, page) only coincides with chunk boundaries
+        # when chunk is a page multiple (a sub-page liberation chunk
+        # like 1792 would leave the widened window's old parity
+        # unread and zero-filled: silent corruption).
+        to_write = {
+            s: (
+                es.align(sinfo.chunk_size)
+                if sinfo.is_parity_shard(s) else es
+            )
+            for s, es in to_write.items()
+        }
 
     def clip_to_stored(shard: int, es: ExtentSet) -> ExtentSet:
         stored = sinfo.object_size_to_shard_size(object_size, shard)
@@ -287,11 +304,15 @@ class ShardBackend:
         from .inject import ec_inject
 
         oid = txn.oids()[0] if txn.oids() else ""
-        if ec_inject.test_write_error3(oid):
+        if ec_inject.test_write_error3(oid, exact=True):
             # ECInject write type 3: the receiving OSD aborts in
             # handle_sub_write (ECBackend.cc:922-926). In-process
             # analog: the shard's OSD dies — nothing applies, no ack,
-            # and the shard drops out of the acting set.
+            # and the shard drops out of the acting set. Exact-oid
+            # consult: at the daemon tier this hop sees per-shard
+            # store keys and the daemon already consulted the rule
+            # under the base oid — matching here too would decrement
+            # when/duration twice per op.
             self.down_shards.add(shard)
             return
         if ec_inject.test_write_error1(oid, shard):

@@ -224,10 +224,25 @@ class ShardExtentMap:
         present here, delta = old XOR new; parity' = parity XOR
         sum_i G[:,i] * delta_i. ``old_map`` must hold the old data AND
         old parity over this map's window."""
+        from ceph_tpu.codecs.interface import Flag
+
         k, m = self.sinfo.k, self.sinfo.m
         lo, hi = self._slice_window()
         if hi <= lo:
             return
+        # Packet-layout codes need chunk-shaped delta windows: the
+        # packet decomposition is per-chunk, so the window is widened
+        # to chunk boundaries and every buffer reshaped [n_chunks, cs]
+        # (delta outside the written extents is zero by construction,
+        # and the planner chunk-aligned the parity reads/writes).
+        chunk_gran = bool(
+            codec.get_flags() & Flag.PARITY_DELTA_CHUNK_GRANULARITY
+        )
+        if chunk_gran:
+            cs = self.sinfo.chunk_size
+            lo = (lo // cs) * cs
+            hi = -(-hi // cs) * cs
+            shape = ((hi - lo) // cs, cs)
         deltas = {}
         for raw in range(k):
             shard = self.sinfo.get_shard(raw)
@@ -246,21 +261,21 @@ class ShardExtentMap:
                     new[s - lo : e - lo] = self.get(shard, s, e - s)
             # delta is plain GF addition: XOR on the host (a device
             # round-trip per shard would serialize k tunnel RTTs)
-            deltas[raw] = np.bitwise_xor(
-                np.asarray(old), np.asarray(new)
-            )
+            d = np.bitwise_xor(np.asarray(old), np.asarray(new))
+            deltas[raw] = d.reshape(shape) if chunk_gran else d
         if not deltas:
             return
-        parity_in = {
-            k + j: np.asarray(
+        parity_in = {}
+        for j in range(m):
+            p = np.asarray(
                 old_map.get(self.sinfo.get_shard(k + j), lo, hi - lo)
             )
-            for j in range(m)
-        }
+            parity_in[k + j] = p.reshape(shape) if chunk_gran else p
         parity_out = codec.apply_delta(deltas, parity_in)
         for j in range(m):
             self.insert(
-                self.sinfo.get_shard(k + j), lo, np.asarray(parity_out[k + j])
+                self.sinfo.get_shard(k + j), lo,
+                np.asarray(parity_out[k + j]).reshape(-1),
             )
 
     def decode(self, codec, want: set[int], object_size: int) -> None:
@@ -285,9 +300,13 @@ class ShardExtentMap:
         # Survivors must cover the stored part of the window: a shard
         # holding only a sub-range would decode zero-filled gaps into
         # the output (absent bytes are zero ONLY beyond shard size).
+        # EXACT size, not the page-rounded one: codecs whose chunk is
+        # not a page multiple (liberation family, chunk = w * align)
+        # store data shards to the exact tail — the page-rounding gap
+        # is zeros by convention, not missing bytes.
         present_raw = []
         for shard in self._bufs:
-            ssize = sinfo.object_size_to_shard_size(object_size, shard)
+            ssize = sinfo.object_size_to_exact_shard_size(object_size, shard)
             end = min(hi, ssize)
             if end <= lo or self.get_extent_set(shard).contains(lo, end - lo):
                 present_raw.append(sinfo.get_raw_shard(shard))

@@ -1,0 +1,100 @@
+"""Round-2 high-k sweep: confirm the s/pad rule across k (see exp_highk.py)."""
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.gf import gf_matrix_to_bitmatrix
+from ceph_tpu.gf.matrices import cauchy_good_matrix
+from ceph_tpu.ops import pallas_encode as pe
+from ceph_tpu.ops.bitplane import gf_encode_bitplane
+from exp_highk import BATCH, CHUNK, _gbps, variant
+
+
+def run(k, m, cands):
+    g = cauchy_good_matrix(k, m)
+    bmat_np = gf_matrix_to_bitmatrix(g[k:, :])
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (BATCH, k, CHUNK), np.uint8))
+    small = jnp.asarray(rng.integers(0, 256, (8, k, 8192), np.uint8))
+    ref = np.asarray(gf_encode_bitplane(jnp.asarray(bmat_np), small))
+    print(
+        f"k={k} m={m} cur="
+        f"{_gbps(lambda d: pe.gf_encode_bitplane_pallas(bmat_np, d), data, k):.1f}",
+        flush=True,
+    )
+    for s, pad, tile in cands:
+        f = s * k + pad
+        name = f"  s{s} F={f} tile={tile//1024}k"
+        try:
+            got = np.asarray(variant(bmat_np, k, m, s, pad, 2048, False)(small))
+            if not np.array_equal(got, ref):
+                print(f"{name}: WRONG", flush=True)
+                continue
+            gb = _gbps(variant(bmat_np, k, m, s, pad, tile, False), data, k)
+            print(f"{name}: {gb:.1f} GB/s", flush=True)
+        except Exception as e:
+            print(f"{name}: fail {type(e).__name__} {str(e)[:60]}", flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "21"
+    if which == "21":
+        run(21, 4, [
+            (1, 3, 32768), (1, 3, 65536),      # F=24
+            (1, 11, 32768),                     # F=32
+            (2, 6, 32768), (2, 6, 16384),       # F=48
+            (2, 2, 32768),                      # F=44
+        ])
+    elif which == "16":
+        run(16, 4, [
+            (1, 0, 32768), (1, 0, 65536),       # F=16
+            (1, 8, 32768),                      # F=24
+            (2, 0, 32768), (2, 0, 65536),       # F=32
+            (2, 8, 32768),                      # F=40
+        ])
+    elif which == "32":
+        run(32, 3, [
+            (1, 0, 32768), (1, 0, 16384),       # F=32
+            (1, 8, 32768),                      # F=40
+            (1, 16, 32768),                     # F=48
+            (2, 0, 16384), (2, 0, 32768),       # F=64
+        ])
+    elif which == "12":
+        # k=12: s2 F=24 pad0 — the sweet spot exactly
+        run(12, 4, [(2, 0, 32768), (2, 0, 65536), (1, 0, 32768), (1, 4, 32768)])
+    elif which == "8":
+        # flagship: does s4/F=32 beat the shipping s2/F=16?
+        run(8, 4, [
+            (2, 0, 65536), (2, 0, 32768),       # F=16 (shipping)
+            (4, 0, 32768), (4, 0, 65536),       # F=32 full-useful
+            (2, 8, 32768),                       # F=24
+            (1, 24, 32768),                      # F=32 pad-heavy
+        ])
+    elif which == "16b":
+        run(16, 4, [
+            (1, 0, 65536), (1, 0, 32768),        # F=16
+            (2, 0, 32768), (2, 0, 65536),        # F=32
+            (1, 8, 32768),                        # F=24
+        ])
+    elif which == "32b":
+        run(32, 3, [
+            (1, 0, 32768), (1, 0, 65536), (1, 0, 16384),  # F=32
+        ])
+    elif which == "10b":
+        run(10, 4, [
+            (1, 6, 65536), (1, 6, 32768),         # F=16 (rule candidate)
+            (1, 2, 65536),                         # F=12
+            (2, 4, 32768), (2, 4, 65536),          # F=24 (prev winner)
+        ])
+    elif which == "12b":
+        run(12, 4, [(1, 4, 65536), (2, 0, 32768), (2, 0, 65536)])
+    elif which == "28":
+        # liberation k=4 w=7 packet shape: c = 28
+        run(28, 8, [(1, 4, 65536), (1, 4, 32768), (1, 0, 65536)])
+
+
+if __name__ == "__main__":
+    main()
