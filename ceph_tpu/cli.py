@@ -67,15 +67,66 @@ class Cluster:
         self.secret: bytes | None = None
         if os.path.exists(keyring):
             self.secret = open(keyring, "rb").read().strip() or None
-        self.mon_store = MonStore(os.path.join(root, "mon", "store.log"))
-        initial, history = self.mon_store.replay()
-        self.mon = Monitor(
-            initial=initial, commit_fn=self.mon_store.append,
-            history=history,
-            pool_id_floor=self.mon_store.pool_id_floor(),
-        )
-        if len(history) > self.mon_store.keep:
-            self.mon_store.trim(initial)
+        # mon tier: a single authority by default; ``vstart --mons N``
+        # records N in root/mons and every later boot runs a real
+        # quorum (MonQuorumService: Paxos-committed epochs, leader
+        # routing, per-rank durable stores)
+        mons_file = os.path.join(root, "mons")
+        self.n_mons = 1
+        if os.path.exists(mons_file):
+            raw = open(mons_file).read().strip()
+            try:
+                self.n_mons = max(1, int(raw or 1))
+            except ValueError:
+                # a garbled mons file must not brick every command —
+                # infer the quorum size from the rank-store dirs
+                ranks = [
+                    d for d in os.listdir(root)
+                    if d.startswith("mon.") and d[4:].isdigit()
+                ]
+                self.n_mons = max(1, len(ranks))
+                print(
+                    f"warning: unreadable {mons_file} ({raw!r}); "
+                    f"assuming {self.n_mons} mons from rank stores",
+                    file=sys.stderr,
+                )
+        if self.n_mons > 1:
+            self._boot_mon_quorum(root)
+        else:
+            self.mon_store = MonStore(os.path.join(root, "mon", "store.log"))
+            initial, history = self.mon_store.replay()
+            # a cluster DOWNGRADED from a quorum: the rank stores may
+            # be ahead of the legacy store — abandoning them would
+            # silently lose every epoch committed in quorum mode (and
+            # regress the pool-id floor into reuse hazards). Seed from
+            # the newest store, whichever tier wrote it.
+            for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
+                if not (name.startswith("mon.") and name[4:].isdigit()):
+                    continue
+                rs = MonStore(os.path.join(root, name, "store.log"))
+                rm, rh = rs.replay()
+                if rm.epoch > initial.epoch:
+                    by_epoch = {i.epoch: i for i in rh}
+                    if all(
+                        e in by_epoch
+                        for e in range(initial.epoch + 1, rm.epoch + 1)
+                    ):
+                        for e in range(initial.epoch + 1, rm.epoch + 1):
+                            self.mon_store.append(by_epoch[e])
+                    else:
+                        self.mon_store.trim(rm)
+                    initial, history = self.mon_store.replay()
+            self.mon = Monitor(
+                initial=initial, commit_fn=self.mon_store.append,
+                history=history,
+                pool_id_floor=max(
+                    self.mon_store.pool_id_floor(),
+                    max(p.pool_id for p in initial.pools.values())
+                    if initial.pools else 0,
+                ),
+            )
+            if len(history) > self.mon_store.keep:
+                self.mon_store.trim(initial)
         self.daemons: dict[int, OSDDaemon] = {}
         for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
             if not name.startswith("osd."):
@@ -91,6 +142,58 @@ class Cluster:
         for osd in sorted(self.mon.osdmap.up_osds() - set(self.daemons)):
             self.mon.osd_down(osd)
         self.client = RadosClient(self.mon, backoff=0.02, secret=self.secret)
+
+    def _boot_mon_quorum(self, root: str) -> None:
+        """N monitor ranks, each with its own durable store; the map
+        service is the quorum handle (leader-routed, Paxos-committed).
+        Resume takes the highest-epoch rank store as canonical and
+        heals laggards (the mon store sync phase)."""
+        from ceph_tpu.cluster.mon_quorum import (
+            MonQuorumService,
+            QuorumMonitor,
+        )
+
+        self.mon_stores = [
+            MonStore(os.path.join(root, f"mon.{r}", "store.log"))
+            for r in range(self.n_mons)
+        ]
+        replays = [s.replay() for s in self.mon_stores]
+        initial, history = max(replays, key=lambda t: t[0].epoch)
+        # growing from a single-mon cluster: its store is the seed
+        # when it is ahead of every rank store (the 1 -> N migration).
+        # The store DIR is the identity (the KV store lives beside the
+        # legacy log-file path, which MonStore removes after import).
+        legacy_dir = os.path.join(root, "mon")
+        legacy_store = None
+        if os.path.isdir(legacy_dir):
+            legacy_store = MonStore(os.path.join(legacy_dir, "store.log"))
+            lm, lh = legacy_store.replay()
+            if lm.epoch > initial.epoch:
+                initial, history = lm, lh
+        by_epoch = {i.epoch: i for i in history}
+        for r, (m, _h) in enumerate(replays):
+            if m.epoch >= initial.epoch:
+                continue
+            # heal a lagging store: contiguous tail append when the
+            # window reaches back far enough, else full-map snapshot
+            if all(
+                e in by_epoch for e in range(m.epoch + 1, initial.epoch + 1)
+            ):
+                for e in range(m.epoch + 1, initial.epoch + 1):
+                    self.mon_stores[r].append(by_epoch[e])
+            else:
+                self.mon_stores[r].trim(initial)
+        floor = max(s.pool_id_floor() for s in self.mon_stores)
+        if legacy_store is not None:
+            floor = max(floor, legacy_store.pool_id_floor())
+        self.mon_quorum = MonQuorumService(
+            self.n_mons,
+            on_commit=lambda r, incr: self.mon_stores[r].append(incr),
+            initial=initial,
+            history=history,
+            pool_id_floor=floor,
+        )
+        self.mon = QuorumMonitor(self.mon_quorum)
 
     def add_osd(self, osd: int, zone: str = "", backend: str | None = None) -> None:
         self.mon.osd_crush_add(osd, zone=zone)
@@ -140,13 +243,22 @@ def cmd_vstart(cl: Cluster, args) -> int:
             f.write(_secrets.token_hex(32) + "\n")
         print("keyring written: cluster runs AES-GCM secure mode from "
               "the next invocation")
+    if getattr(args, "mons", None):
+        with open(os.path.join(cl.root, "mons"), "w") as f:
+            f.write(str(max(1, args.mons)))
+        if args.mons != cl.n_mons:
+            print(f"mon quorum size set to {args.mons}: takes effect "
+                  "from the next invocation")
     existing = set(cl.daemons)
     for i in range(args.osds):
         if i not in existing:
             cl.add_osd(
                 i, zone=f"z{i % max(args.zones, 1)}", backend=args.store
             )
-    print(f"cluster up: {len(cl.daemons)} osds, epoch "
+    mons = (f"{cl.n_mons} mons (leader mon."
+            f"{cl.mon_quorum.leader_rank()})" if cl.n_mons > 1
+            else "1 mon")
+    print(f"cluster up: {len(cl.daemons)} osds, {mons}, epoch "
           f"{cl.mon.osdmap.epoch}, dir {cl.root}")
     if getattr(args, "exporter", None) is not None:
         import time as _time
@@ -479,6 +591,11 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("vstart", help="create/boot a dev cluster")
     s.add_argument("--osds", type=int, default=6)
     s.add_argument("--zones", type=int, default=3)
+    s.add_argument(
+        "--mons", type=int, default=None,
+        help="monitor quorum size (>1 boots a Paxos quorum with "
+             "leader routing from the next invocation)",
+    )
     s.add_argument(
         "--store", choices=("file", "block"), default=None,
         help="OSD backend for NEW osds: FileStore tree or BlockStore "

@@ -163,6 +163,29 @@ class Monitor:
             for fn in subs:
                 fn(m)
 
+    def apply_committed(self, incr: Incremental) -> None:
+        """Learn one externally committed incremental — the replica/
+        learner path of a monitor quorum: apply WITHOUT proposing
+        (the leader already drove it through Paxos), keep history and
+        the pool-id floor, notify local subscribers. Idempotent for
+        already-applied epochs; refuses gaps (callers replay the log
+        in order)."""
+        with self._command():
+            if incr.epoch <= self.osdmap.epoch:
+                return
+            if incr.epoch != self.osdmap.epoch + 1:
+                raise ValueError(
+                    f"learn gap: at epoch {self.osdmap.epoch}, "
+                    f"got {incr.epoch}"
+                )
+            self.osdmap = self.osdmap.apply(incr)
+            self._incrementals[incr.epoch] = incr
+            for p in incr.new_pools:
+                self._next_pool_id = max(
+                    self._next_pool_id, p.pool_id + 1
+                )
+            self._pending_notify.append(self.osdmap)
+
     # -- subscriptions (monc analog) ------------------------------------
     def subscribe(self, fn: Callable[[OSDMap], None]) -> None:
         with self._lock:
