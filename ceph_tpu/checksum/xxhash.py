@@ -46,9 +46,9 @@ def _unroll_split(nsteps: int, cap: int = 16) -> tuple[int, int]:
 
 
 def _le32(b: jax.Array) -> jax.Array:
-    """[..., 4] uint8 -> [...] uint32 little-endian."""
-    w = b.astype(jnp.uint32)
-    return w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+    """[..., 4] uint8 -> [...] uint32 little-endian — a free bitcast
+    (TPU and the CPU CI backend are both little-endian)."""
+    return jax.lax.bitcast_convert_type(b, jnp.uint32)
 
 
 @functools.partial(jax.jit, static_argnames=("block_bytes",))
@@ -118,8 +118,16 @@ def xxh32_kernel(
 
 
 def _le64_pair(b: jax.Array):
-    """[..., 8] uint8 -> (hi, lo) uint32 little-endian."""
-    return (_le32(b[..., 4:8]), _le32(b[..., 0:4]))
+    """[..., 8] uint8 -> (hi, lo) uint32 little-endian.
+
+    A BITCAST, not byte shifts: the lanes are already little-endian
+    contiguous bytes, so reinterpreting [..., 2, 4] uint8 as uint32
+    is free — the shift-assembly this replaces cost ~10 VPU ops per
+    lane and measured up to 38% of the whole xxh64 kernel (round 4)."""
+    w = jax.lax.bitcast_convert_type(
+        b.reshape(b.shape[:-1] + (2, 4)), jnp.uint32
+    )
+    return (w[..., 1], w[..., 0])
 
 
 def _xxh64_round(acc, lane):

@@ -99,11 +99,15 @@ class Cluster:
             # be ahead of the legacy store — abandoning them would
             # silently lose every epoch committed in quorum mode (and
             # regress the pool-id floor into reuse hazards). Seed from
-            # the newest store, whichever tier wrote it.
+            # the newest store, and take the pool-id floor across ALL
+            # stores (a rank store's trimmed history may remember ids
+            # the survivor's window no longer does).
+            floor = self.mon_store.pool_id_floor()
             for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
                 if not (name.startswith("mon.") and name[4:].isdigit()):
                     continue
                 rs = MonStore(os.path.join(root, name, "store.log"))
+                floor = max(floor, rs.pool_id_floor())
                 rm, rh = rs.replay()
                 if rm.epoch > initial.epoch:
                     by_epoch = {i.epoch: i for i in rh}
@@ -119,11 +123,7 @@ class Cluster:
             self.mon = Monitor(
                 initial=initial, commit_fn=self.mon_store.append,
                 history=history,
-                pool_id_floor=max(
-                    self.mon_store.pool_id_floor(),
-                    max(p.pool_id for p in initial.pools.values())
-                    if initial.pools else 0,
-                ),
+                pool_id_floor=floor,
             )
             if len(history) > self.mon_store.keep:
                 self.mon_store.trim(initial)
@@ -159,17 +159,29 @@ class Cluster:
         ]
         replays = [s.replay() for s in self.mon_stores]
         initial, history = max(replays, key=lambda t: t[0].epoch)
-        # growing from a single-mon cluster: its store is the seed
-        # when it is ahead of every rank store (the 1 -> N migration).
+        # the canonical seed may live OUTSIDE ranks 0..n-1: the legacy
+        # single-mon store (1 -> N growth) or a higher rank's store
+        # (shrinking the quorum after its leader sat above the new n).
         # The store DIR is the identity (the KV store lives beside the
         # legacy log-file path, which MonStore removes after import).
         legacy_dir = os.path.join(root, "mon")
         legacy_store = None
+        extra_floor = 0
         if os.path.isdir(legacy_dir):
             legacy_store = MonStore(os.path.join(legacy_dir, "store.log"))
             lm, lh = legacy_store.replay()
             if lm.epoch > initial.epoch:
                 initial, history = lm, lh
+        for name in sorted(os.listdir(root)):
+            if not (name.startswith("mon.") and name[4:].isdigit()):
+                continue
+            if int(name[4:]) < self.n_mons:
+                continue  # in-quorum rank, already replayed above
+            ds = MonStore(os.path.join(root, name, "store.log"))
+            extra_floor = max(extra_floor, ds.pool_id_floor())
+            dm, dh = ds.replay()
+            if dm.epoch > initial.epoch:
+                initial, history = dm, dh
         by_epoch = {i.epoch: i for i in history}
         for r, (m, _h) in enumerate(replays):
             if m.epoch >= initial.epoch:
@@ -184,6 +196,7 @@ class Cluster:
             else:
                 self.mon_stores[r].trim(initial)
         floor = max(s.pool_id_floor() for s in self.mon_stores)
+        floor = max(floor, extra_floor)
         if legacy_store is not None:
             floor = max(floor, legacy_store.pool_id_floor())
         self.mon_quorum = MonQuorumService(

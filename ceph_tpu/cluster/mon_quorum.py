@@ -73,6 +73,13 @@ class MonQuorumService:
         #: persistence must track separately from map epoch
         base = initial.epoch if initial is not None else 0
         self._persisted = [base] * n
+        #: per-rank replay cursor (highest log slot applied into the
+        #: rank's Monitor) — keeps _catch_up incremental instead of
+        #: re-decoding the whole committed log every command
+        self._applied_slot = [-1] * n
+        #: rank -> incremental blob whose propose is in flight (the
+        #: at-most-once record for failover retries)
+        self._pending_blob: dict[int, bytes] = {}
         self._leader_rank = 0
 
     # -- commit path (leader-only) -------------------------------------
@@ -91,7 +98,16 @@ class MonQuorumService:
                 raise QuorumLost(
                     f"mon.{rank} is not the leader (mon.{leader.rank} is)"
                 )
-            self.paxos.commit(incr.to_bytes(), leader)
+            blob = incr.to_bytes()
+            # at-most-once bookkeeping: record the blob BEFORE the
+            # propose. If the leader dies mid-propose, the value may
+            # survive as a minority-accepted orphan that the next
+            # leader's sync MUST resurrect (Paxos safety) — the proxy
+            # consults this record to avoid re-running a command whose
+            # incremental actually committed.
+            self._pending_blob[rank] = blob
+            self.paxos.commit(blob, leader)
+            self._pending_blob.pop(rank, None)
             # durable BEFORE the Monitor applies and notifies — the
             # same ordering the single-mon path gets from
             # commit_fn=store.append. Without this, a crash between
@@ -146,14 +162,21 @@ class MonQuorumService:
         persist anything not yet in its store — including the
         leader's own commits, which apply through _propose."""
         mon = self.monitors[rank]
-        for blob in self.paxos.nodes[rank].committed_values():
-            incr = Incremental.from_bytes(blob)
+        node = self.paxos.nodes[rank]
+        slot = self._applied_slot[rank] + 1
+        while True:
+            s = node.slots.get(slot)
+            if s is None or s.committed is None:
+                break
+            incr = Incremental.from_bytes(s.committed)
             if incr.epoch > mon.osdmap.epoch:
                 mon.apply_committed(incr)
             if incr.epoch > self._persisted[rank]:
                 if self._on_commit is not None:
                     self._on_commit(rank, incr)
                 self._persisted[rank] = incr.epoch
+            self._applied_slot[rank] = slot
+            slot += 1
 
     def replicate(self) -> None:
         """Push the committed log into every LIVE replica's Monitor —
@@ -231,21 +254,38 @@ class QuorumMonitor:
             raise AttributeError(name)
 
         def call(*args, **kwargs):
+            svc = self.service
             last: Exception | None = None
-            for _ in range(self.service.n):
-                mon = self.service.leader()
+            for _ in range(svc.n):
+                rank = svc.leader_rank()
+                mon = svc.monitors[rank]
                 try:
                     out = getattr(mon, name)(*args, **kwargs)
-                    self.service.replicate()
+                    svc.replicate()
                     return out
                 except QuorumLost as e:
                     last = e
                     # leader died between election and commit: if a
                     # DIFFERENT live leader exists, retry there;
                     # otherwise surface the stall
-                    if self.service._leader_rank in self.service.dead:
-                        continue
-                    raise
+                    if rank not in svc.dead:
+                        raise
+                    # at-most-once: the dead leader's propose may have
+                    # left a minority-accepted value that the NEW
+                    # leader's sync resurrects and commits. If that
+                    # exact blob is now in the log, the command's
+                    # effect landed — re-running it would double-apply.
+                    orphan = svc._pending_blob.pop(rank, None)
+                    if orphan is not None:
+                        new_leader = svc.leader()  # syncs + catches up
+                        node = svc.paxos.nodes[svc._leader_rank]
+                        if any(
+                            s.committed == orphan
+                            for s in node.slots.values()
+                        ):
+                            svc.replicate()
+                            return new_leader.osdmap
+                    continue
             raise last if last is not None else QuorumLost("no leader")
 
         return call

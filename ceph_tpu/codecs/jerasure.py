@@ -18,8 +18,11 @@ Technique parity with the reference:
 - blaum_roth        — RAID-6 bit-matrix, w+1 prime, k <= w
 - liber8tion        — RAID-6 bit-matrix, w = 8, k <= 8
 
-Profile keys: k, m, technique, w, packetsize (accepted; packet geometry
-is derived from chunk size on TPU).
+Profile keys: k, m, technique, w, packetsize. ``packetsize`` is
+validated, not swallowed: packet geometry on TPU is derived from chunk
+size (chunk = w packets), so an explicit nonzero packetsize — which
+would demand jerasure's packet-interleaved byte layout — is rejected
+with a clear error; 0/omitted means auto (the reference's default).
 """
 
 from __future__ import annotations
@@ -47,6 +50,24 @@ from .matrix_codec import MatrixErasureCodec
 from .registry import registry
 
 
+def _reject_packetsize(profile: ErasureCodeProfile) -> None:
+    """packetsize: VALIDATED, not silently swallowed — module-wide.
+    The TPU packet/byte geometry is derived from chunk size, so a
+    profile demanding jerasure's explicit packet-interleaved layout
+    cannot be honored bit-for-bit; reject it loudly. Omitting the key
+    (or 0 = "auto", the reference's default handling) keeps the
+    derived geometry."""
+    ps = to_int("packetsize", profile, 0)
+    if ps < 0:
+        raise ValueError(f"packetsize={ps} must be >= 0")
+    if ps > 0:
+        raise ValueError(
+            "explicit packetsize is not supported: packet geometry "
+            "is derived from chunk size; omit the key or pass 0 for "
+            "auto"
+        )
+
+
 class JerasureMatrixCodec(MatrixErasureCodec):
     technique = "reed_sol_van"
     DEFAULT_K = 2   # ErasureCodeJerasure defaults (k=2, m=1 upstream)
@@ -54,6 +75,7 @@ class JerasureMatrixCodec(MatrixErasureCodec):
 
     def init(self, profile: ErasureCodeProfile) -> None:
         self.profile = dict(profile)
+        _reject_packetsize(profile)
         self.k = to_int("k", profile, self.DEFAULT_K)
         self.m = to_int("m", profile, self.DEFAULT_M)
         self.w = to_int("w", profile, 8)
@@ -111,6 +133,7 @@ class LiberationBase(BitMatrixCodec):
         self.k = to_int("k", profile, 2)
         self.m = to_int("m", profile, 2)
         self.w = to_int("w", profile, self.DEFAULT_W)
+        _reject_packetsize(profile)
         if self.k < 1:
             raise ValueError(f"k={self.k} must be >= 1")
         if self.m != 2:

@@ -236,3 +236,29 @@ def test_clay_repair_traced_matches_numpy(rng):
         )
         got = np.asarray(fn(*[jnp.asarray(helper[kk]) for kk in keys]))
         np.testing.assert_array_equal(got, ref)
+
+
+def test_jerasure_packetsize_validated_not_swallowed():
+    """Explicit packetsize demands jerasure's packet-interleaved
+    layout, which the chunk-derived TPU geometry cannot honor
+    bit-for-bit — reject loudly; 0/omitted means auto."""
+    from ceph_tpu.codecs import registry
+
+    base = {"technique": "liberation", "k": "4", "m": "2", "w": "7"}
+    registry.factory("jerasure", dict(base))                      # ok
+    registry.factory("jerasure", dict(base, packetsize="0"))      # auto
+    with pytest.raises(ValueError, match="packetsize"):
+        registry.factory("jerasure", dict(base, packetsize="2048"))
+    with pytest.raises(ValueError, match="packetsize"):
+        registry.factory("jerasure", dict(base, packetsize="-1"))
+
+
+def test_packetsize_guard_covers_matrix_techniques():
+    from ceph_tpu.codecs import registry
+
+    for tech in ("reed_sol_van", "cauchy_good", "cauchy_orig"):
+        with pytest.raises(ValueError, match="packetsize"):
+            registry.factory("jerasure", {
+                "technique": tech, "k": "4", "m": "2",
+                "packetsize": "2048",
+            })
