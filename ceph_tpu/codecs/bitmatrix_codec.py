@@ -223,7 +223,11 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         einsum), de-packetize. ``tables`` passes precomputed
         bit-expanded forms (the encode path keeps them resident)."""
         packets = self._to_packets(stacked)
-        if not self._mesh_routable(packets) and self._host_sized(packets):
+        if (
+            not self._mesh_routable(packets)
+            and not self._dcn_routable(packets)
+            and self._host_sized(packets)
+        ):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc(f"host_{op}")

@@ -48,6 +48,7 @@ def _dispatch_counters():
 
     b = PerfCountersBuilder(perf_collection, "ec_dispatch")
     for op in ("encode", "decode", "delta"):
+        b.add_u64_counter(f"dcn_{op}", f"{op}s fanned across DCN hosts")
         b.add_u64_counter(f"mesh_{op}", f"{op}s sharded over the mesh")
         b.add_u64_counter(f"pallas_{op}", f"{op}s served by the Pallas kernel")
         b.add_u64_counter(f"einsum_{op}", f"{op}s served by the einsum engine")
@@ -155,6 +156,25 @@ class BitplaneDispatchMixin:
         )
         return mesh_dispatch.mesh_supported(mesh, (0, c * 8), flat_shape)
 
+    def _dcn_routable(self, stacked) -> bool:
+        """True when a DCN cluster is installed AND this host-staged
+        shape will ride it — like _mesh_routable, this must outrank
+        the host small-op shortcut, or default-config dispatches
+        (< ec_host_dispatch_bytes) would silently never leave the
+        host."""
+        from ceph_tpu.parallel import dispatch as mesh_dispatch
+
+        dcn = mesh_dispatch.get_dcn()
+        if dcn is None or not isinstance(stacked, np.ndarray):
+            return False
+        c = stacked.shape[-2]
+        flat_shape = (
+            int(np.prod(stacked.shape[:-2], initial=1)),
+            c,
+            stacked.shape[-1],
+        )
+        return dcn.supported((0, c * 8), flat_shape)
+
     def _dispatch_bitmatrix(
         self,
         bmat_np: np.ndarray,
@@ -170,10 +190,22 @@ class BitplaneDispatchMixin:
         from ceph_tpu.ops import pallas_encode as pe
         from ceph_tpu.utils import config
 
+        # DCN outranks every single-host route: with a multi-host
+        # cluster installed, host-staged dispatches fan out across OS
+        # processes (the AsyncMessenger sub-op fan-out over the data-
+        # center network). Device-resident inputs stay on this chip —
+        # shipping them through the control plane would force a sync.
+        from ceph_tpu.parallel import dispatch as mesh_dispatch
+
+        dcn = mesh_dispatch.get_dcn()
+        if dcn is not None and isinstance(stacked, np.ndarray):
+            flat = stacked.reshape((-1,) + stacked.shape[-2:])
+            if dcn.supported(bmat_np.shape, flat.shape):
+                _dispatch_counters().inc(f"dcn_{op}")
+                out = dcn.apply_bitmatrix(bmat_np, flat)
+                return out.reshape(stacked.shape[:-2] + out.shape[-2:])
         mesh = self._active_mesh()
         if mesh is not None:
-            from ceph_tpu.parallel import dispatch as mesh_dispatch
-
             flat = stacked.reshape((-1,) + stacked.shape[-2:])
             if mesh_dispatch.mesh_supported(
                 mesh, bmat_np.shape, flat.shape
@@ -239,7 +271,11 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         inputs, the fused Pallas MXU kernel on TPU when the shape
         tiles (config-gated), einsum otherwise. A mesh-routable shape
         outranks the host shortcut (see _active_mesh)."""
-        if not self._mesh_routable(stacked) and self._host_sized(stacked):
+        if (
+            not self._mesh_routable(stacked)
+            and not self._dcn_routable(stacked)
+            and self._host_sized(stacked)
+        ):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_encode")
@@ -268,6 +304,7 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         if (
             all(isinstance(v, np.ndarray) for v in vals)
             and not self._mesh_routable(np.stack(vals, axis=-2))
+            and not self._dcn_routable(np.stack(vals, axis=-2))
             and self._host_sized(*vals)
         ):
             from ceph_tpu.gf import gf_apply_bytes_host
@@ -281,7 +318,13 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
             bmat_np, bmat_dev = self._tables.get(
                 key, lambda: self._build_decode_bmat(present, want)
             )
-            stacked = jnp.stack(vals, axis=-2)
+            # host inputs stay host-stacked so the DCN route (which
+            # ships bytes, not device arrays) can claim them; the
+            # device routes accept either
+            if all(isinstance(v, np.ndarray) for v in vals):
+                stacked = np.stack(vals, axis=-2)
+            else:
+                stacked = jnp.stack(vals, axis=-2)
             out = self._dispatch_bitmatrix(
                 bmat_np, bmat_dev, stacked, "decode"
             )
@@ -336,6 +379,7 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         if (
             all(isinstance(v, np.ndarray) for v in vals)
             and not self._mesh_routable(np.stack(vals, axis=-2))
+            and not self._dcn_routable(np.stack(vals, axis=-2))
             and self._host_sized(*vals)
         ):
             from ceph_tpu.gf import gf_apply_bytes_host
@@ -358,7 +402,10 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         bmat_np, bmat_dev = self._tables.get(
             ("delta", tuple(cols)), _build_delta
         )
-        stacked = jnp.stack(vals, axis=-2)
+        if all(isinstance(v, np.ndarray) for v in vals):
+            stacked = np.stack(vals, axis=-2)  # DCN-claimable (see decode)
+        else:
+            stacked = jnp.stack(vals, axis=-2)
         contrib = self._dispatch_bitmatrix(
             bmat_np, bmat_dev, stacked, "delta"
         )

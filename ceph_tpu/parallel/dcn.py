@@ -125,6 +125,8 @@ def _worker_main(argv: list[str]) -> None:
         if cmd.kind == "shutdown":
             done.set()
             return DcnReply(cmd.tid, args.rank, {"ok": True})
+        if cmd.kind == "apply":
+            return _run_apply(cmd)
         codec = get_codec(meta)
         b, c, n = meta["shape"]
         sp = mesh.shape["sp"]
@@ -185,6 +187,45 @@ def _worker_main(argv: list[str]) -> None:
             {"ok": True, "counters": delta, "shape": list(full.shape),
              "hosts": args.nprocs},
             full.tobytes(),
+        )
+
+    def _run_apply(cmd: DcnCmd) -> DcnReply:
+        """Raw bitmatrix application — the generic engine op the codec
+        dispatch route ships over DCN (encode, decode and delta all
+        reduce to it; the payload is bitmatrix bytes + this host's
+        shard-slice)."""
+        meta = cmd.meta
+        r8, c8 = meta["bm_shape"]
+        bm_bytes = c8 * r8
+        bm_np = np.frombuffer(
+            cmd.payload[:bm_bytes], np.uint8
+        ).reshape(r8, c8)
+        b, c, n = meta["shape"]
+        sp = mesh.shape["sp"]
+        local = np.frombuffer(
+            cmd.payload[bm_bytes:], np.uint8
+        ).reshape(b, c // sp, n)
+        sharding = NamedSharding(mesh, P("dp", "sp", None))
+        stacked = jax.make_array_from_process_local_data(
+            sharding, local, (b, c, n)
+        )
+        out = mesh_dispatch.mesh_apply_bitmatrix(mesh, bm_np, stacked)
+        # every rank holds the full (sp-replicated) output, but the
+        # coordinator reads only rank 0's copy — the others ACK with
+        # metadata so (n_hosts-1) x output bytes never cross the wire
+        if args.rank == 0:
+            full = _assemble_addressable(out)
+            return DcnReply(
+                cmd.tid, args.rank,
+                {"ok": True, "shape": list(full.shape),
+                 "hosts": args.nprocs, "counters": {}},
+                full.tobytes(),
+            )
+        out.block_until_ready()
+        return DcnReply(
+            cmd.tid, args.rank,
+            {"ok": True, "shape": list(out.shape),
+             "hosts": args.nprocs, "counters": {}},
         )
 
     def dispatch(c, msg) -> None:
@@ -371,8 +412,11 @@ class DcnCluster:
 
     # -- ops -----------------------------------------------------------
     def _next_tid(self) -> int:
-        self._tid += 1
-        return self._tid
+        # under the lock: OSD daemons dispatch from multiple reader
+        # threads — a raced tid would cross-deliver replies
+        with self._lock:
+            self._tid += 1
+            return self._tid
 
     def _wait(self, tid: int, timeout: float = OP_TIMEOUT,
               strict: bool = True) -> dict[int, object]:
@@ -385,6 +429,11 @@ class DcnCluster:
                     if (tid, r) in self._replies
                 }
                 if len(got) == self.n_hosts:
+                    # consume: replies carry whole output payloads —
+                    # leaking them per-op would grow without bound on
+                    # the codec dispatch hot path
+                    for r in got:
+                        del self._replies[(tid, r)]
                     return got
                 left = deadline - time.monotonic()
                 if left <= 0:
@@ -393,6 +442,8 @@ class DcnCluster:
                             f"DCN op {tid}: {len(got)}/{self.n_hosts} "
                             f"replies"
                         )
+                    for r in got:
+                        del self._replies[(tid, r)]
                     return got
                 self._cv.wait(min(left, 0.5))
 
@@ -431,6 +482,84 @@ class DcnCluster:
             r: rep.meta["counters"] for r, rep in replies.items()
         }
         return out, counters
+
+    def supported(self, bm_shape, data_shape) -> bool:
+        """Divisibility contract for the generic apply route: the
+        shard axis must split across hosts, the bitmatrix must match
+        it, and the stripe batch must split over each host's devices
+        — directly or by folding the lane axis (the same exactness
+        argument as mesh_apply_bitmatrix: the GF(2) apply is
+        independent per lane)."""
+        if len(data_shape) != 3:
+            return False
+        b, c, n = data_shape
+        dp = self.devices_per_host
+        return (
+            c % self.n_hosts == 0
+            and bm_shape[1] == c * 8
+            and (b % dp == 0 or n % dp == 0)
+        )
+
+    def apply_bitmatrix(self, bm_np: np.ndarray, data: np.ndarray):
+        """Generic [R*8, C*8] bitmatrix over [B, C, N] host data,
+        fanned across hosts (the engine-route op: encode, decode and
+        parity delta all arrive here when the codec dispatch routes
+        over DCN)."""
+        from ceph_tpu.msg.messages import DcnCmd
+
+        b0, c, n0 = data.shape
+        dp = self.devices_per_host
+        fold = b0 % dp != 0
+        if fold:
+            # batch-1 deltas and odd stripe batches: fold the lane
+            # axis into the batch so dp divides it (exact — the
+            # bitmatrix apply is lane-independent)
+            if n0 % dp:
+                raise ValueError(
+                    f"batch {b0} and lanes {n0} both unsplittable by "
+                    f"dp={dp}"
+                )
+            data = (
+                data.reshape(b0, c, dp, n0 // dp)
+                .transpose(0, 2, 1, 3)
+                .reshape(b0 * dp, c, n0 // dp)
+            )
+        b, c, n = data.shape
+        sp = self.n_hosts
+        if c % sp:
+            raise ValueError(f"shard axis {c} must divide hosts {sp}")
+        tid = self._next_tid()
+        meta = {
+            "bm_shape": [int(bm_np.shape[0]), int(bm_np.shape[1])],
+            "shape": [b, c, n],
+        }
+        bm_bytes = np.ascontiguousarray(bm_np, np.uint8).tobytes()
+        blk = c // sp
+        for rank, conn in self.conns.items():
+            slice_ = np.ascontiguousarray(
+                data[:, rank * blk : (rank + 1) * blk, :]
+            )
+            conn.send(DcnCmd(
+                tid, "apply", meta, bm_bytes + slice_.tobytes()
+            ))
+        replies = self._wait(tid)
+        for r, rep in sorted(replies.items()):
+            if not rep.meta.get("ok"):
+                raise RuntimeError(
+                    f"DCN host {r}: {rep.meta.get('error')}"
+                )
+        rep0 = replies[0]
+        out = np.frombuffer(rep0.payload, np.uint8).reshape(
+            rep0.meta["shape"]
+        )
+        if fold:
+            r_out = out.shape[1]
+            out = (
+                out.reshape(b0, dp, r_out, n)
+                .transpose(0, 2, 1, 3)
+                .reshape(b0, r_out, n0)
+            )
+        return out
 
     def encode(self, plugin: str, profile: dict, data: np.ndarray):
         """[B, k, N] data -> ([B, m, N] parity, per-host counters)."""

@@ -35,6 +35,13 @@ from jax.sharding import Mesh
 # operator installed.
 _mesh: Mesh | None = None
 
+#: the installed multi-HOST cluster (parallel/dcn.DcnCluster): when
+#: present, host-staged codec dispatches fan out across OS-process
+#: hosts — the operator installing it IS the opt-in, mirroring the
+#: reference where configuring the messenger's peer map turns a
+#: single-daemon build into a cluster member
+_dcn = None
+
 
 def set_mesh(mesh: Mesh | None) -> None:
     """Install (or clear) the process-wide EC dispatch mesh."""
@@ -46,6 +53,16 @@ def get_mesh() -> Mesh | None:
     return _mesh
 
 
+def set_dcn(cluster) -> None:
+    """Install (or clear) the process-wide DCN dispatch cluster."""
+    global _dcn
+    _dcn = cluster
+
+
+def get_dcn():
+    return _dcn
+
+
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh | None):
     """Scoped mesh activation (tests, dryruns)."""
@@ -55,6 +72,17 @@ def use_mesh(mesh: Mesh | None):
         yield mesh
     finally:
         set_mesh(prev)
+
+
+@contextlib.contextmanager
+def use_dcn(cluster):
+    """Scoped DCN-cluster activation (tests, dryruns)."""
+    prev = get_dcn()
+    set_dcn(cluster)
+    try:
+        yield cluster
+    finally:
+        set_dcn(prev)
 
 
 def mesh_supported(
