@@ -244,12 +244,6 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
 
         return self._tables.get(("bits", mat01.tobytes()), build)
 
-    @staticmethod
-    def _stack(vals: list) -> "np.ndarray | jax.Array":
-        if all(isinstance(v, np.ndarray) for v in vals):
-            return np.stack(vals, axis=-2)
-        return jnp.stack(vals, axis=-2)
-
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:

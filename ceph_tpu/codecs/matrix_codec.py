@@ -64,6 +64,12 @@ def _dispatch_counters():
         "batch nor the lane axis divided dp (the shard axis always "
         "zero-pads to sp) and a single-chip route served the op",
     )
+    b.add_u64_counter(
+        "dcn_fallback",
+        "dispatches where the DCN cluster failed mid-op (host death / "
+        "timeout): the cluster is uninstalled, a single-host route "
+        "serves the op, and the operator re-installs after repair",
+    )
     return b.create_perf_counters()
 
 
@@ -156,6 +162,16 @@ class BitplaneDispatchMixin:
         )
         return mesh_dispatch.mesh_supported(mesh, (0, c * 8), flat_shape)
 
+    @staticmethod
+    def _stack(vals: list):
+        """Stack shard buffers along the shard axis, KEEPING host
+        arrays host-side (np): the DCN route ships bytes, and the
+        host GF shortcut reads them in place — converting to device
+        arrays here would bar both. One policy for every family."""
+        if all(isinstance(v, np.ndarray) for v in vals):
+            return np.stack(vals, axis=-2)
+        return jnp.stack(vals, axis=-2)
+
     def _dcn_routable(self, stacked) -> bool:
         """True when a DCN cluster is installed AND this host-staged
         shape will ride it — like _mesh_routable, this must outrank
@@ -201,9 +217,26 @@ class BitplaneDispatchMixin:
         if dcn is not None and isinstance(stacked, np.ndarray):
             flat = stacked.reshape((-1,) + stacked.shape[-2:])
             if dcn.supported(bmat_np.shape, flat.shape):
-                _dispatch_counters().inc(f"dcn_{op}")
-                out = dcn.apply_bitmatrix(bmat_np, flat)
-                return out.reshape(stacked.shape[:-2] + out.shape[-2:])
+                try:
+                    out = dcn.apply_bitmatrix(bmat_np, flat)
+                    _dispatch_counters().inc(f"dcn_{op}")
+                    return out.reshape(
+                        stacked.shape[:-2] + out.shape[-2:]
+                    )
+                except Exception as e:
+                    # a dead/hung host must not wedge the data path:
+                    # uninstall the cluster (every later op would pay
+                    # the timeout again) and serve this op on a
+                    # single-host route. The operator re-installs
+                    # after repairing the cluster.
+                    _dispatch_counters().inc("dcn_fallback")
+                    mesh_dispatch.set_dcn(None)
+                    from ceph_tpu.utils.log import get_logger
+
+                    get_logger("ec-dcn").error(
+                        "DCN dispatch failed; cluster uninstalled:",
+                        type(e).__name__, str(e)[:200],
+                    )
         mesh = self._active_mesh()
         if mesh is not None:
             flat = stacked.reshape((-1,) + stacked.shape[-2:])
@@ -300,12 +333,14 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         if not want:
             return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
-        vals = [chunks[i] for i in present]
+        # ONE stack reused by routability checks and both routes (the
+        # old per-check restack copied all shard data 2-3x per op)
+        stacked = self._stack([chunks[i] for i in present])
         if (
-            all(isinstance(v, np.ndarray) for v in vals)
-            and not self._mesh_routable(np.stack(vals, axis=-2))
-            and not self._dcn_routable(np.stack(vals, axis=-2))
-            and self._host_sized(*vals)
+            isinstance(stacked, np.ndarray)
+            and not self._mesh_routable(stacked)
+            and not self._dcn_routable(stacked)
+            and self._host_sized(stacked)
         ):
             from ceph_tpu.gf import gf_apply_bytes_host
 
@@ -313,18 +348,11 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
             mat = self._host_tables.get(
                 key, lambda: self._build_decode_bytes(present, want)
             )
-            out = gf_apply_bytes_host(mat, np.stack(vals, axis=-2))
+            out = gf_apply_bytes_host(mat, stacked)
         else:
             bmat_np, bmat_dev = self._tables.get(
                 key, lambda: self._build_decode_bmat(present, want)
             )
-            # host inputs stay host-stacked so the DCN route (which
-            # ships bytes, not device arrays) can claim them; the
-            # device routes accept either
-            if all(isinstance(v, np.ndarray) for v in vals):
-                stacked = np.stack(vals, axis=-2)
-            else:
-                stacked = jnp.stack(vals, axis=-2)
             out = self._dispatch_bitmatrix(
                 bmat_np, bmat_dev, stacked, "decode"
             )
@@ -375,18 +403,18 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         one small matmul over just the changed columns.
         """
         cols = sorted(delta)
-        vals = [delta[c] for c in cols]
+        stacked = self._stack([delta[c] for c in cols])  # one copy
         if (
-            all(isinstance(v, np.ndarray) for v in vals)
-            and not self._mesh_routable(np.stack(vals, axis=-2))
-            and not self._dcn_routable(np.stack(vals, axis=-2))
-            and self._host_sized(*vals)
+            isinstance(stacked, np.ndarray)
+            and not self._mesh_routable(stacked)
+            and not self._dcn_routable(stacked)
+            and self._host_sized(stacked)
         ):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_delta")
             contrib = gf_apply_bytes_host(
-                self.generator[self.k :, cols], np.stack(vals, axis=-2)
+                self.generator[self.k :, cols], stacked
             )
             return {
                 pid: np.bitwise_xor(
@@ -402,10 +430,6 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         bmat_np, bmat_dev = self._tables.get(
             ("delta", tuple(cols)), _build_delta
         )
-        if all(isinstance(v, np.ndarray) for v in vals):
-            stacked = np.stack(vals, axis=-2)  # DCN-claimable (see decode)
-        else:
-            stacked = jnp.stack(vals, axis=-2)
         contrib = self._dispatch_bitmatrix(
             bmat_np, bmat_dev, stacked, "delta"
         )

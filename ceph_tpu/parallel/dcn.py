@@ -284,6 +284,10 @@ class DcnCluster:
         self.devices_per_host = devices_per_host
         self.procs: list[subprocess.Popen] = []
         self._errfiles: list = []
+        #: tids with a waiter: replies for anything else (stragglers
+        #: after a timeout) are dropped at arrival instead of
+        #: accumulating payload bytes forever
+        self._awaiting: set[int] = set()
         self.conns: dict[int, object] = {}
         self.hellos: dict[int, object] = {}
         self._replies: dict[tuple[int, int], object] = {}
@@ -306,7 +310,9 @@ class DcnCluster:
                     self.hellos[msg.rank] = msg
                     self.conns[msg.rank] = conn
                 elif isinstance(msg, DcnReply):
-                    self._replies[(msg.tid, msg.rank)] = msg
+                    if msg.tid in self._awaiting:
+                        self._replies[(msg.tid, msg.rank)] = msg
+                    # else: straggler after a timeout — drop it
                 self._cv.notify_all()
 
         self.msgr.set_dispatcher(dispatch)
@@ -413,39 +419,45 @@ class DcnCluster:
     # -- ops -----------------------------------------------------------
     def _next_tid(self) -> int:
         # under the lock: OSD daemons dispatch from multiple reader
-        # threads — a raced tid would cross-deliver replies
+        # threads — a raced tid would cross-deliver replies. The tid
+        # registers as awaited HERE, before any send, so a fast reply
+        # can never race past the filter in the dispatcher.
         with self._lock:
             self._tid += 1
+            self._awaiting.add(self._tid)
             return self._tid
 
     def _wait(self, tid: int, timeout: float = OP_TIMEOUT,
               strict: bool = True) -> dict[int, object]:
         deadline = time.monotonic() + timeout
         with self._cv:
-            while True:
-                got = {
-                    r: self._replies[(tid, r)]
-                    for r in range(self.n_hosts)
-                    if (tid, r) in self._replies
-                }
-                if len(got) == self.n_hosts:
-                    # consume: replies carry whole output payloads —
-                    # leaking them per-op would grow without bound on
-                    # the codec dispatch hot path
-                    for r in got:
-                        del self._replies[(tid, r)]
-                    return got
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    if strict:
-                        raise TimeoutError(
-                            f"DCN op {tid}: {len(got)}/{self.n_hosts} "
-                            f"replies"
-                        )
-                    for r in got:
-                        del self._replies[(tid, r)]
-                    return got
-                self._cv.wait(min(left, 0.5))
+            try:
+                while True:
+                    got = {
+                        r: self._replies[(tid, r)]
+                        for r in range(self.n_hosts)
+                        if (tid, r) in self._replies
+                    }
+                    if len(got) == self.n_hosts:
+                        return got
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        if strict:
+                            raise TimeoutError(
+                                f"DCN op {tid}: {len(got)}/"
+                                f"{self.n_hosts} replies"
+                            )
+                        return got
+                    self._cv.wait(min(left, 0.5))
+            finally:
+                # consume on EVERY exit (complete, timeout, raise):
+                # replies carry whole output payloads — leaking them
+                # per-op would grow without bound on the codec
+                # dispatch hot path, and un-awaited stragglers are
+                # dropped at arrival
+                self._awaiting.discard(tid)
+                for r in range(self.n_hosts):
+                    self._replies.pop((tid, r), None)
 
     def _run(self, kind: str, plugin: str, profile: dict,
              data: np.ndarray, meta_extra: dict | None = None):
@@ -500,11 +512,16 @@ class DcnCluster:
             and (b % dp == 0 or n % dp == 0)
         )
 
-    def apply_bitmatrix(self, bm_np: np.ndarray, data: np.ndarray):
+    def apply_bitmatrix(
+        self, bm_np: np.ndarray, data: np.ndarray,
+        timeout: float = 60.0,
+    ):
         """Generic [R*8, C*8] bitmatrix over [B, C, N] host data,
         fanned across hosts (the engine-route op: encode, decode and
         parity delta all arrive here when the codec dispatch routes
-        over DCN)."""
+        over DCN). Shorter timeout than the command ops: this sits on
+        the data path, where a dead host should fail fast into the
+        dispatcher's fallback."""
         from ceph_tpu.msg.messages import DcnCmd
 
         b0, c, n0 = data.shape
@@ -542,7 +559,7 @@ class DcnCluster:
             conn.send(DcnCmd(
                 tid, "apply", meta, bm_bytes + slice_.tobytes()
             ))
-        replies = self._wait(tid)
+        replies = self._wait(tid, timeout=timeout)
         for r, rep in sorted(replies.items()):
             if not rep.meta.get("ok"):
                 raise RuntimeError(

@@ -170,3 +170,49 @@ def test_rmw_pipeline_routes_over_dcn(cluster):
         ).tobytes()
         pos += chunk
     assert bytes(got) == bytes(expect), "DCN-routed RMW corrupted data"
+
+
+def test_dead_cluster_fails_fast_into_fallback():
+    """A dead/hung DCN host must not wedge the data path: the dispatch
+    engine falls back to a single-host route, uninstalls the cluster,
+    and the op still completes correctly."""
+    import functools
+
+    from ceph_tpu.codecs.matrix_codec import _dispatch_counters
+    from ceph_tpu.codecs.registry import registry
+    from ceph_tpu.parallel import dispatch as mesh_dispatch
+    from ceph_tpu.parallel.dcn import DcnCluster
+    from ceph_tpu.utils import config
+
+    codec = registry.factory("isa", {"k": "4", "m": "2"})
+    rng = np.random.default_rng(33)
+    data = {
+        i: rng.integers(0, 256, (4096,), np.uint8) for i in range(4)
+    }
+    expect = codec.encode_chunks(dict(data))
+
+    dcn = DcnCluster(n_hosts=2, devices_per_host=2).start()
+    # the engine's default timeout is data-path sized (60s); for the
+    # test, bind a short one so the dead-host path resolves quickly
+    dcn.apply_bitmatrix = functools.partial(
+        DcnCluster.apply_bitmatrix, dcn, timeout=5.0
+    )
+    for p in dcn.procs:
+        p.kill()          # hosts die WITHOUT goodbye
+    config.set("ec_host_dispatch_bytes", 0)
+    pc = _dispatch_counters()
+    before = pc.get("dcn_fallback")
+    try:
+        with mesh_dispatch.use_dcn(dcn):
+            got = codec.encode_chunks(dict(data))
+            assert mesh_dispatch.get_dcn() is None, (
+                "failed cluster must be uninstalled"
+            )
+    finally:
+        config.rm("ec_host_dispatch_bytes")
+        dcn.stop()
+    assert pc.get("dcn_fallback") == before + 1
+    for j in expect:
+        np.testing.assert_array_equal(
+            np.asarray(got[j]), np.asarray(expect[j])
+        )
