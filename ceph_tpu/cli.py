@@ -206,6 +206,24 @@ class Cluster:
             history=history,
             pool_id_floor=floor,
         )
+        # operator-stopped ranks stay down across invocations (the
+        # osd "stopped" marker convention, mon tier). Boot-time clamp:
+        # markers that would leave a minority are IGNORED — a wedged
+        # quorum cannot serve the commands needed to unwedge it, so
+        # the directory would be unrecoverable from the CLI.
+        stopped = [
+            r for r in range(self.n_mons)
+            if os.path.exists(os.path.join(root, f"mon.{r}", "stopped"))
+        ]
+        if (self.n_mons - len(stopped)) * 2 <= self.n_mons:
+            print(
+                f"warning: stopped markers for mons {stopped} would "
+                "lose quorum; ignoring them (reviving all ranks)",
+                file=sys.stderr,
+            )
+        else:
+            for r in stopped:
+                self.mon_quorum.kill(r)
         self.mon = QuorumMonitor(self.mon_quorum)
 
     def add_osd(self, osd: int, zone: str = "", backend: str | None = None) -> None:
@@ -298,6 +316,13 @@ def cmd_status(cl: Cluster, args) -> int:
     m = cl.mon.osdmap
     up = sorted(m.up_osds())
     print(f"epoch {m.epoch}")
+    if cl.n_mons > 1:
+        svc = cl.mon_quorum
+        live = sorted(set(range(svc.n)) - svc.dead)
+        print(
+            f"mons: {svc.n} total, quorum {live} "
+            f"(leader mon.{svc.leader_rank()})"
+        )
     print(f"osds: {len(m.osds)} total, {len(up)} up {up}")
     for name, spec in sorted(m.pools.items()):
         degraded = sum(
@@ -417,6 +442,51 @@ def cmd_ls(cl: Cluster, args) -> int:
 def cmd_stat(cl: Cluster, args) -> int:
     size = cl.client.open_ioctx(args.pool).stat(args.oid)
     print(f"{args.pool}/{args.oid}: {size} bytes")
+    return 0
+
+
+def cmd_mon_kill(cl: Cluster, args) -> int:
+    """Take a monitor rank down durably (the mon-chaos surface).
+    Refuses to kill into a lost quorum — a majority-dead quorum
+    cannot serve the commands needed to revive it."""
+    if cl.n_mons < 2:
+        print("single-mon cluster: nothing to kill", file=sys.stderr)
+        return 1
+    svc = cl.mon_quorum
+    r = args.rank
+    if r < 0 or r >= svc.n:
+        print(f"no such mon rank {r}", file=sys.stderr)
+        return 1
+    live_after = svc.n - len(svc.dead | {r})
+    if live_after * 2 <= svc.n:
+        # strictly-more-than-half must survive — for ANY n, odd or
+        # even (an earlier >= n+1 pre-check skipped the guard at n=2
+        # and wedged the cluster directory)
+        print(
+            f"refusing: killing mon.{r} would leave {live_after}/"
+            f"{svc.n} — quorum lost and unrecoverable from the "
+            "CLI", file=sys.stderr,
+        )
+        return 1
+    svc.kill(r)
+    open(os.path.join(cl.root, f"mon.{r}", "stopped"), "w").close()
+    print(f"mon.{r} killed (leader now mon.{svc.leader_rank()})")
+    return 0
+
+
+def cmd_mon_revive(cl: Cluster, args) -> int:
+    if cl.n_mons < 2:
+        print("single-mon cluster", file=sys.stderr)
+        return 1
+    svc = cl.mon_quorum
+    if args.rank < 0 or args.rank >= svc.n:
+        print(f"no such mon rank {args.rank}", file=sys.stderr)
+        return 1
+    marker = os.path.join(cl.root, f"mon.{args.rank}", "stopped")
+    if os.path.exists(marker):
+        os.remove(marker)
+    svc.revive(args.rank)
+    print(f"mon.{args.rank} revived (caught up from the quorum log)")
     return 0
 
 
@@ -677,6 +747,17 @@ def build_parser() -> argparse.ArgumentParser:
     ):
         s = sub.add_parser(name)
         s.add_argument("osd", type=int)
+        s.set_defaults(fn=fn)
+
+    for name, fn in (
+        ("mon-kill", cmd_mon_kill),
+        ("mon-revive", cmd_mon_revive),
+    ):
+        s = sub.add_parser(
+            name, help=f"{name.split('-')[1]} a monitor rank "
+            "(quorum chaos surface; --mons > 1 clusters)"
+        )
+        s.add_argument("rank", type=int)
         s.set_defaults(fn=fn)
 
     s = sub.add_parser("scrub")

@@ -187,6 +187,7 @@ class PaxosNode:
             pn = self._next_pn()
             # phase 1: prepare / collect
             promises = 0
+            responders = 0
             best_pn, best_val = 0, None
             for rank in self.transport.nodes:
                 r = self.transport.call(
@@ -194,16 +195,24 @@ class PaxosNode:
                 )
                 if r is None:
                     continue
+                responders += 1
                 ok, acc_pn, acc_val = r
                 if ok:
                     promises += 1
                     if acc_val is not None and acc_pn > best_pn:
                         best_pn, best_val = acc_pn, acc_val
-            if promises < self.majority:
+            if responders < self.majority:
+                # a genuine partition: a majority is UNREACHABLE
                 raise QuorumLost(
-                    f"rank {self.rank}: {promises}/{self.n_nodes} "
-                    f"promises for slot {slot}"
+                    f"rank {self.rank}: {responders}/{self.n_nodes} "
+                    f"reachable for slot {slot}"
                 )
+            if promises < self.majority:
+                # refused, not unreachable: peers promised a higher pn
+                # (a proposer with a stale round — e.g. a revived
+                # ex-leader). Retry with the next round; conflating
+                # this with QuorumLost wedged exactly that revival.
+                continue
             # adopt any previously accepted value (convergence rule)
             chosen = best_val if best_val is not None else value
             # phase 2: accept / begin

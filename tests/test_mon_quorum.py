@@ -162,3 +162,25 @@ class TestLiveClusterQuorum:
         # and fresh IO through the failed-over control plane works
         io.write("post", payload(2500, seed=999))
         assert io.read("post") == payload(2500, seed=999)
+
+
+class TestRevivedExLeader:
+    def test_revived_ex_leader_with_stale_pn_serves_again(self):
+        """The CLI-exposed case: kill the LEADER, commit through the
+        new leader (its proposal rounds move past the dead rank's),
+        then revive rank 0. Its stale proposal number must be refused
+        and retried — not misread as a lost quorum."""
+        svc = MonQuorumService(3)
+        mon = QuorumMonitor(svc)
+        mon.osd_crush_add(0, zone="z")
+        svc.kill(0)
+        for i in range(1, 4):
+            mon.osd_crush_add(i, zone=f"z{i}")   # commits via mon.1
+        svc.revive(0)
+        assert svc.leader_rank() == 0            # lowest rank leads again
+        mon.osd_crush_add(4, zone="z4")          # rank 0 proposes: works
+        for r in range(3):
+            assert (
+                svc.monitors[r].osdmap.to_bytes()
+                == mon.osdmap.to_bytes()
+            ), f"rank {r} diverged after ex-leader revival"
