@@ -32,6 +32,7 @@ from ceph_tpu.msg.messages import (
     WatchNotify,
 )
 from ceph_tpu.msg.messenger import Connection, Messenger
+from ceph_tpu.utils import tracer
 
 from .osdmap import SHARD_NONE
 
@@ -130,8 +131,16 @@ class Objecter:
         name: str = "",
         snap: int = 0,
     ) -> OSDOpReply:
-        last = "no attempt made"
         reqid = f"{self.client_id}.{next(self._reqs)}"
+        with tracer.span("client_op", op=op, pool=pool, oid=oid):
+            return self._submit_traced(
+                pool, oid, op, offset, length, data, name, snap, reqid
+            )
+
+    def _submit_traced(
+        self, pool, oid, op, offset, length, data, name, snap, reqid
+    ) -> OSDOpReply:
+        last = "no attempt made"
         # True once an attempt's outcome is unknown (timeout or lost
         # connection after send): the op may have applied without us
         # seeing the reply.
@@ -160,10 +169,11 @@ class Objecter:
             with self._lock:
                 self._waiting[tid] = entry
             try:
+                t_id, t_span = tracer.current()
                 self._conn(addr).send(
                     OSDOp(tid, osdmap.epoch, pool, oid, op,
                           offset, length, data, name, reqid=reqid,
-                          snap=snap)
+                          snap=snap, trace_id=t_id, parent_span=t_span)
                 )
                 if not entry["event"].wait(self.op_timeout):
                     last = f"osd.{primary} timed out"

@@ -69,6 +69,7 @@ from ceph_tpu.pipeline.rmw import (
 )
 from ceph_tpu.pipeline.stripe import StripeInfo
 from ceph_tpu.store import MemStore, Transaction
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.mclock import MClockScheduler
 
 from .osdmap import OSDMap, SHARD_NONE
@@ -971,13 +972,25 @@ class OSDDaemon:
                 # joins the worker/messenger threads this may run on.
                 threading.Thread(target=self.stop, daemon=True).start()
                 return
-            self.local.submit_shard_txn(
-                self.osd_id,
-                msg.txn,
-                lambda: conn.send(ECSubWriteReply(msg.tid, msg.shard)),
-            )
+            with tracer.continue_trace(msg.trace_id, msg.parent_span):
+                with tracer.span(
+                    "sub_write", osd=self.osd_id, shard=msg.shard,
+                    tid=msg.tid,
+                ):
+                    self.local.submit_shard_txn(
+                        self.osd_id,
+                        msg.txn,
+                        lambda: conn.send(
+                            ECSubWriteReply(msg.tid, msg.shard)
+                        ),
+                    )
         elif isinstance(msg, ECSubRead):
-            self._handle_sub_read(conn, msg)
+            with tracer.continue_trace(msg.trace_id, msg.parent_span):
+                with tracer.span(
+                    "sub_read", osd=self.osd_id, shard=msg.shard,
+                    tid=msg.tid,
+                ):
+                    self._handle_sub_read(conn, msg)
         elif isinstance(msg, GetAttrs):
             serve_get_attrs(self.store, self.osd_id, conn, msg)
         elif isinstance(msg, PGList):
@@ -1056,7 +1069,16 @@ class OSDDaemon:
 
     def _run_client_op(self, conn: Connection, msg: OSDOp) -> None:
         try:
-            reply = self._execute_client_op(msg, conn)
+            # adopt the client's trace context (the wire hop of the
+            # ZTracer-through-the-pipeline pattern): this daemon's
+            # spans — and the sub-op spans it fans out — share the
+            # client op's trace id
+            with tracer.continue_trace(msg.trace_id, msg.parent_span):
+                with tracer.span(
+                    "osd_op", op=msg.op, oid=msg.oid,
+                    osd=self.osd_id, tid=msg.tid,
+                ):
+                    reply = self._execute_client_op(msg, conn)
         except Exception as e:  # never kill the worker
             self.log.error(
                 "client op", msg.op, f"{msg.pool}/{msg.oid}",

@@ -63,17 +63,26 @@ class ECSubWrite:
     tid: int
     shard: int
     txn: Transaction
+    trace_id: str | None = None
+    parent_span: str | None = None
 
     def encode(self) -> list[bytes]:
         return [
-            _header("sub_write", {"tid": self.tid, "shard": self.shard}),
+            _header("sub_write", {
+                "tid": self.tid, "shard": self.shard,
+                "trace": [self.trace_id, self.parent_span],
+            }),
             self.txn.to_bytes(),
         ]
 
     @classmethod
     def decode(cls, segments: list[bytes]) -> "ECSubWrite":
         h = _parse(segments[0], "sub_write")
-        return cls(h["tid"], h["shard"], Transaction.from_bytes(segments[1]))
+        trace = h.get("trace") or [None, None]
+        return cls(
+            h["tid"], h["shard"], Transaction.from_bytes(segments[1]),
+            trace[0], trace[1],
+        )
 
 
 @dataclass
@@ -111,6 +120,8 @@ class ECSubRead:
     #: the server cross-checks it against the stored SI attr so a
     #: CRUSH remap can't serve misplaced bytes (None = don't check).
     logical: int | None = None
+    trace_id: str | None = None
+    parent_span: str | None = None
 
     def encode(self) -> list[bytes]:
         return [
@@ -123,6 +134,7 @@ class ECSubRead:
                     "extents": self.extents,
                     "subchunks": self.subchunks,
                     "logical": self.logical,
+                    "trace": [self.trace_id, self.parent_span],
                 },
             )
         ]
@@ -131,6 +143,7 @@ class ECSubRead:
     def decode(cls, segments: list[bytes]) -> "ECSubRead":
         h = _parse(segments[0], "sub_read")
         sub = h["subchunks"]
+        trace = h.get("trace") or [None, None]
         return cls(
             h["tid"],
             h["shard"],
@@ -138,6 +151,8 @@ class ECSubRead:
             [tuple(e) for e in h["extents"]],
             [tuple(s) for s in sub] if sub is not None else None,
             h.get("logical"),
+            trace[0],
+            trace[1],
         )
 
 
@@ -236,6 +251,11 @@ class OSDOp:
     #: snapshot id a read targets (0 = head); the primary resolves
     #: the clone (rados_ioctx_snap_set_read role)
     snap: int = 0
+    #: distributed-trace context (ZTracer/blkin role: the reference
+    #: threads trace handles through op messages); optional and
+    #: version-tolerant
+    trace_id: str | None = None
+    parent_span: str | None = None
 
     def encode(self) -> list[bytes]:
         return [
@@ -252,6 +272,7 @@ class OSDOp:
                     "name": self.name,
                     "reqid": self.reqid,
                     "snap": self.snap,
+                    "trace": [self.trace_id, self.parent_span],
                 },
             ),
             self.data,
@@ -260,10 +281,12 @@ class OSDOp:
     @classmethod
     def decode(cls, segments: list[bytes]) -> "OSDOp":
         h = _parse(segments[0], "osd_op")
+        trace = h.get("trace") or [None, None]
         return cls(
             h["tid"], h["epoch"], h["pool"], h["oid"], h["op"],
             h["offset"], h["length"], segments[1], h.get("name", ""),
             h.get("reqid", ""), h.get("snap", 0),
+            trace[0], trace[1],
         )
 
 

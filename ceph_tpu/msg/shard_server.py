@@ -31,6 +31,7 @@ import time
 from collections.abc import Callable
 
 from ceph_tpu.store import MemStore, Transaction
+from ceph_tpu.utils import tracer
 
 from .messages import (
     ECSubRead,
@@ -306,8 +307,10 @@ class NetShardBackend:
                 cb(shard, dict(zip(reply.offsets, reply.buffers)))
 
         self._register(tid, shard, oid, on_reply, is_read=True)
+        t_id, t_span = tracer.current()
         msg = ECSubRead(
-            tid, shard, oid, [(s, e) for s, e in extents], logical=logical
+            tid, shard, oid, [(s, e) for s, e in extents], logical=logical,
+            trace_id=t_id, parent_span=t_span,
         )
         if not self._send(shard, msg, tid):
             self._inbox.put(lambda: cb(shard, ShardReadError(shard, oid)))
@@ -386,7 +389,12 @@ class NetShardBackend:
             # else parked: ack never fires, recovery's problem
 
         self._register(tid, shard, "", on_reply, is_read=False)
-        self._send(shard, ECSubWrite(tid, shard, txn), tid)
+        t_id, t_span = tracer.current()
+        self._send(
+            shard,
+            ECSubWrite(tid, shard, txn, trace_id=t_id, parent_span=t_span),
+            tid,
+        )
 
     # -- heartbeats (OSD::handle_osd_ping / stale-ping culling) --------
     def start_heartbeat(

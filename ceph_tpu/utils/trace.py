@@ -15,21 +15,33 @@ with device timelines in XLA profile captures.
 from __future__ import annotations
 
 import itertools
+import os
+import secrets
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+#: process-unique prefix so trace ids stay distinct across the DCN
+#: tier's OS-process hosts (the blkin trace-id role)
+_TRACE_PREFIX = f"{os.getpid():x}-{secrets.token_hex(2)}"
+
 
 @dataclass
 class Span:
-    span_id: int
-    parent_id: int | None
+    #: globally unique (process-prefixed) — parent links survive
+    #: merging dump_historic output across DCN host processes, where
+    #: bare per-process counters would collide
+    span_id: str
+    parent_id: str | None
     name: str
     start: float
     duration: float | None = None
     tags: dict = field(default_factory=dict)
+    #: one id per END-TO-END operation, carried across the wire
+    #: (client op -> primary -> replica sub-ops all share it)
+    trace_id: str | None = None
 
     def as_dict(self) -> dict:
         return {
@@ -39,6 +51,7 @@ class Span:
             "start": self.start,
             "duration": self.duration,
             "tags": self.tags,
+            "trace_id": self.trace_id,
         }
 
 
@@ -62,7 +75,15 @@ class Tracer:
             return
         stack = self._stack()
         parent = stack[-1].span_id if stack else None
-        sp = Span(next(self._ids), parent, name, time.time(), tags=tags)
+        trace_id = (
+            stack[-1].trace_id
+            if stack
+            else f"{_TRACE_PREFIX}-{next(self._ids)}"
+        )
+        sp = Span(
+            f"{_TRACE_PREFIX}-{next(self._ids)}", parent, name,
+            time.time(), tags=tags, trace_id=trace_id,
+        )
         stack.append(sp)
         t0 = time.perf_counter()
         annotation = None
@@ -82,6 +103,38 @@ class Tracer:
             stack.pop()
             with self._lock:
                 self._history.append(sp)
+
+    def current(self) -> tuple[str | None, str | None]:
+        """(trace_id, span_id) of the innermost open span — what a
+        sender stamps into an outgoing message."""
+        stack = self._stack()
+        if not stack:
+            return None, None
+        return stack[-1].trace_id, stack[-1].span_id
+
+    @contextmanager
+    def continue_trace(self, trace_id: str | None, parent_id: str | None):
+        """Adopt a REMOTE trace context (the wire hop of
+        ZTracer/blkin: the reference threads trace handles through the
+        EC pipeline signatures and the sub-op messages,
+        osd/ECBackend.h:70-94). Spans opened inside link to the
+        sender's span and share its trace id, so one client op's
+        spans correlate across the client, the primary, and every
+        replica — dump_historic filtered by trace_id IS the
+        distributed trace."""
+        if not self.enabled or trace_id is None:
+            yield
+            return
+        stack = self._stack()
+        marker = Span(
+            parent_id if parent_id is not None else "",
+            None, "<remote>", time.time(), trace_id=trace_id,
+        )
+        stack.append(marker)
+        try:
+            yield
+        finally:
+            stack.pop()
 
     def dump_historic(self, limit: int | None = None) -> list[dict]:
         with self._lock:

@@ -267,3 +267,63 @@ class TestDebugModes:
 
         apply_debug_modes()
         apply_debug_modes()
+
+
+class TestDistributedTrace:
+    """Trace context crosses the wire (the ZTracer/blkin hop the
+    reference threads through op + sub-op messages,
+    osd/ECBackend.h:70-94): one client op's spans on the client, the
+    primary, and every replica share a trace id."""
+
+    def test_client_op_trace_spans_daemons(self):
+        import numpy as np
+
+        from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+        from ceph_tpu.utils import tracer
+
+        tracer.clear()
+        mon = Monitor()
+        daemons = []
+        for i in range(5):
+            mon.osd_crush_add(i, zone=f"z{i % 3}")
+        for i in range(5):
+            d = OSDDaemon(i, mon, chunk_size=1024)
+            d.start()
+            daemons.append(d)
+        mon.osd_erasure_code_profile_set(
+            "rs32", {"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "3", "m": "2"}
+        )
+        mon.osd_pool_create("tp", 4, "rs32")
+        client = RadosClient(mon, backoff=0.01)
+        try:
+            io = client.open_ioctx("tp")
+            data = np.random.default_rng(1).integers(
+                0, 256, 5000, np.uint8
+            ).tobytes()
+            io.write("tobj", data)
+            assert io.read("tobj") == data
+        finally:
+            client.shutdown()
+            for d in daemons:
+                d.stop()
+        spans = tracer.dump_historic()
+        client_spans = [
+            s for s in spans
+            if s["name"] == "client_op" and s["tags"].get("oid") == "tobj"
+        ]
+        assert client_spans, "client span missing"
+        tid = client_spans[0]["trace_id"]
+        names = {
+            s["name"] for s in spans if s["trace_id"] == tid
+        }
+        # the trace crossed the wire: the primary's op span and the
+        # replica sub-op spans share the client op's trace id
+        assert "osd_op" in names, names
+        assert "sub_write" in names, names
+        # and EVERY span of the op correlates by that one id
+        osd_spans = [
+            s for s in spans
+            if s["trace_id"] == tid and s["name"] == "sub_write"
+        ]
+        assert len(osd_spans) >= 2, "sub-op fan-out not traced"
