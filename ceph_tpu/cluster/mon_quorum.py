@@ -239,15 +239,37 @@ class QuorumMonitor:
     def __init__(self, service: MonQuorumService) -> None:
         self.service = service
 
+    def _best_effort_mon(self) -> Monitor:
+        """The most advanced live rank's Monitor, no quorum required —
+        map READS are monc-cache state (the data plane keeps serving
+        on the last committed map when the quorum is gone); only map
+        CHANGES need consensus."""
+        try:
+            return self.service.leader()
+        except QuorumLost:
+            svc = self.service
+            with svc._lock:
+                live = [r for r in range(svc.n) if r not in svc.dead]
+                # replay each survivor's LOCALLY committed slots first
+                # (needs no quorum): a rank can hold epoch N+1 in its
+                # acceptor log while its Monitor is still at N if the
+                # leader died before the post-command replicate()
+                for r in live:
+                    svc._catch_up(r)
+                candidates = [
+                    svc.monitors[r] for r in live
+                ] or list(svc.monitors)
+                return max(candidates, key=lambda m: m.osdmap.epoch)
+
     @property
     def osdmap(self) -> OSDMap:
-        return self.service.leader().osdmap
+        return self._best_effort_mon().osdmap
 
     def subscribe(self, fn: Callable[[OSDMap], None]) -> None:
         self.service.subscribe(fn)
 
     def get_incrementals(self, since: int):
-        return self.service.leader().get_incrementals(since)
+        return self._best_effort_mon().get_incrementals(since)
 
     def __getattr__(self, name: str):
         if name not in self._COMMANDS:

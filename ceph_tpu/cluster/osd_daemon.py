@@ -176,10 +176,14 @@ class _PGBackend:
                 lambda: cb(shard, ShardReadError(shard, oid, kind="missing"))
             )
         elif osd == self.daemon.osd_id:
-            self.daemon.local.read_shard_async(
-                self.daemon.osd_id, key, extents,
-                lambda _s, res: cb(shard, res),
-            )
+            with tracer.span(
+                "sub_read", osd=self.daemon.osd_id, shard=shard,
+                local=True,
+            ):
+                self.daemon.local.read_shard_async(
+                    self.daemon.osd_id, key, extents,
+                    lambda _s, res: cb(shard, res),
+                )
         else:
             self.daemon.peers.read_shard_async(
                 osd, key, extents, lambda _s, res: cb(shard, res),
@@ -216,7 +220,10 @@ class _PGBackend:
             # the primary's own shard goes through handle_sub_write
             # too: ECInject write type 3 aborts it like any receiver
             # (ECBackend.cc:922-926 fires on every OSD, primary
-            # included). Remote shards consult in _dispatch instead.
+            # included), and the sub-op is traced like any receiver's
+            # (a trace missing exactly the primary's shard would
+            # misread as a skipped member). Remote shards consult and
+            # trace in _dispatch instead.
             from ceph_tpu.pipeline.inject import ec_inject
 
             if ec_inject.test_write_error3(loc):
@@ -224,7 +231,13 @@ class _PGBackend:
                     target=self.daemon.stop, daemon=True
                 ).start()
                 return
-            self.daemon.local.submit_shard_txn(self.daemon.osd_id, txn, ack)
+            with tracer.span(
+                "sub_write", osd=self.daemon.osd_id, shard=shard,
+                local=True,
+            ):
+                self.daemon.local.submit_shard_txn(
+                    self.daemon.osd_id, txn, ack
+                )
         else:
             self.daemon.peers.submit_shard_txn(osd, txn, ack)
 

@@ -67,13 +67,10 @@ class ECSubWrite:
     parent_span: str | None = None
 
     def encode(self) -> list[bytes]:
-        return [
-            _header("sub_write", {
-                "tid": self.tid, "shard": self.shard,
-                "trace": [self.trace_id, self.parent_span],
-            }),
-            self.txn.to_bytes(),
-        ]
+        h = {"tid": self.tid, "shard": self.shard}
+        if self.trace_id is not None:  # keep untraced wire bytes lean
+            h["trace"] = [self.trace_id, self.parent_span]
+        return [_header("sub_write", h), self.txn.to_bytes()]
 
     @classmethod
     def decode(cls, segments: list[bytes]) -> "ECSubWrite":
@@ -124,20 +121,17 @@ class ECSubRead:
     parent_span: str | None = None
 
     def encode(self) -> list[bytes]:
-        return [
-            _header(
-                "sub_read",
-                {
-                    "tid": self.tid,
-                    "shard": self.shard,
-                    "oid": self.oid,
-                    "extents": self.extents,
-                    "subchunks": self.subchunks,
-                    "logical": self.logical,
-                    "trace": [self.trace_id, self.parent_span],
-                },
-            )
-        ]
+        h = {
+            "tid": self.tid,
+            "shard": self.shard,
+            "oid": self.oid,
+            "extents": self.extents,
+            "subchunks": self.subchunks,
+            "logical": self.logical,
+        }
+        if self.trace_id is not None:
+            h["trace"] = [self.trace_id, self.parent_span]
+        return [_header("sub_read", h)]
 
     @classmethod
     def decode(cls, segments: list[bytes]) -> "ECSubRead":
@@ -272,7 +266,10 @@ class OSDOp:
                     "name": self.name,
                     "reqid": self.reqid,
                     "snap": self.snap,
-                    "trace": [self.trace_id, self.parent_span],
+                    **(
+                        {"trace": [self.trace_id, self.parent_span]}
+                        if self.trace_id is not None else {}
+                    ),
                 },
             ),
             self.data,

@@ -84,11 +84,17 @@ class ShardServer:
 
             serve_get_attrs(self.store, self.shard, conn, msg)
         elif isinstance(msg, ECSubWrite):
-            self._local.submit_shard_txn(
-                self.shard,
-                msg.txn,
-                lambda: conn.send(ECSubWriteReply(msg.tid, self.shard)),
-            )
+            with tracer.continue_trace(msg.trace_id, msg.parent_span):
+                with tracer.span(
+                    "sub_write", shard=self.shard, tid=msg.tid,
+                ):
+                    self._local.submit_shard_txn(
+                        self.shard,
+                        msg.txn,
+                        lambda: conn.send(
+                            ECSubWriteReply(msg.tid, self.shard)
+                        ),
+                    )
         elif isinstance(msg, ECSubRead):
             from ceph_tpu.pipeline.extents import ExtentSet
 
@@ -109,12 +115,16 @@ class ShardServer:
                         )
                     )
 
-            self._local.read_shard_async(
-                self.shard,
-                msg.oid,
-                ExtentSet((s, e) for s, e in msg.extents),
-                reply,
-            )
+            with tracer.continue_trace(msg.trace_id, msg.parent_span), \
+                    tracer.span(
+                        "sub_read", shard=self.shard, tid=msg.tid,
+                    ):
+                self._local.read_shard_async(
+                    self.shard,
+                    msg.oid,
+                    ExtentSet((s, e) for s, e in msg.extents),
+                    reply,
+                )
 
 
 class _Pending:

@@ -184,3 +184,41 @@ class TestRevivedExLeader:
                 svc.monitors[r].osdmap.to_bytes()
                 == mon.osdmap.to_bytes()
             ), f"rank {r} diverged after ex-leader revival"
+
+
+class TestQuorumLossUnderIO:
+    def test_io_survives_quorum_loss_on_last_map(self):
+        """Majority of mons dead: control-plane commands stall
+        (QuorumLost), but client IO keeps flowing on the last
+        committed map — the reference's mon-quorum-lost behavior
+        (OSDs serve; nothing can change the map)."""
+        svc = MonQuorumService(3)
+        mon = QuorumMonitor(svc)
+        daemons = []
+        for i in range(5):
+            mon.osd_crush_add(i, zone=f"z{i % 3}")
+        for i in range(5):
+            d = OSDDaemon(i, mon, chunk_size=1024)
+            d.start()
+            daemons.append(d)
+        mon.osd_erasure_code_profile_set(
+            "rs32", {"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "3", "m": "2"}
+        )
+        mon.osd_pool_create("qpool", 4, "rs32")
+        client = RadosClient(mon, backoff=0.01)
+        try:
+            io = client.open_ioctx("qpool")
+            io.write("pre", payload(3000))
+            svc.kill(svc.leader_rank())
+            svc.kill(svc.leader_rank())     # two of three dead
+            with pytest.raises(QuorumLost):
+                mon.osd_down(4)             # control plane stalls
+            # data plane: writes AND reads keep working on the last map
+            io.write("during", payload(2500, seed=5))
+            assert io.read("pre") == payload(3000)
+            assert io.read("during") == payload(2500, seed=5)
+        finally:
+            client.shutdown()
+            for d in daemons:
+                d.stop()
