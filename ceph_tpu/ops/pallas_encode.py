@@ -114,8 +114,13 @@ def _v3_matrix(
 def _v3_matrix_cached(
     bitmatrix_bytes: bytes, r8: int, c8: int, s: int, pad: int
 ):
+    """NUMPY only in the cache: caching a device array built inside a
+    jit trace would leak that trace's tracer into every later call
+    with the same key (UnexpectedTracerError on the first eager
+    encode after a traced one — the round-3 lru_cache lesson, hit
+    again by exp_pack.py). pallas_call converts per call site."""
     mat = np.frombuffer(bitmatrix_bytes, np.uint8).reshape(r8, c8)
-    return jnp.asarray(_v3_matrix(mat, c8 // 8, r8 // 8, s, pad))
+    return _v3_matrix(mat, c8 // 8, r8 // 8, s, pad)
 
 
 def _pick_stripes(c: int, batch: int) -> tuple[int, int]:

@@ -1,0 +1,125 @@
+"""Pack-stage experiment (round 4): the v3 kernel's int32->int8 astype
+narrows the FULL accumulator (8SR rows) before the bitcast-nibble
+merge — the playbook's "biggest remaining VPU cost". Variant: fold the
+8 bit-plane rows of each output byte into ONE int32 row first
+(8 and/shift/or ops on row slices), then narrow [SR, T] — 1/8 the
+relayout traffic.
+
+MEASURED DEAD END (v5e, same run): rowfold 43-48 GB/s vs 410 for the
+shipped nibble pack — the per-b row slices of the [SR, 8, T] reshape
+lower to strided sublane gathers that cost far more than the astype
+they avoid. Keep the bitcast-nibble pack. (Running this experiment
+also exposed the _v3_matrix_cached device-array tracer leak, now
+fixed + regression-tested in tests/test_pallas.py.)
+
+Usage: PYTHONPATH=/root/repo python exp_pack.py [k m]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
+from ceph_tpu.ops import pallas_encode as pe
+from ceph_tpu.ops.bitplane import gf_encode_bitplane
+from exp_highk import BATCH, CHUNK, _gbps
+
+
+def _rowfold_matrix(bitmatrix: np.ndarray, c: int, r: int, s: int, pad: int):
+    """Stationary matrix for the row-fold pack variant: acc row
+    = si*(8*r) + j*8 + bp (bit-plane-minor PER OUTPUT BYTE, so the
+    fold combines 8 adjacent rows)."""
+    f = s * c + pad
+    mat = np.zeros((8 * s * r, 8 * f), np.int8)
+    for si in range(s):
+        for j in range(r):
+            for bp in range(8):
+                row = si * (8 * r) + j * 8 + bp
+                for b in range(8):
+                    for i in range(c):
+                        mat[row, b * f + si * c + i] = bitmatrix[
+                            j * 8 + bp, i * 8 + b
+                        ]
+    return mat
+
+
+def _make_kernel(c, r, s, pad):
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(bmat_ref, data_ref, out_ref):
+        d = data_ref[:]
+        t = d.shape[2]
+        flat = d.reshape(s * c, t)
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
+            )
+        bits = pe.unpack_bitplanes(flat, False)
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [8SR, T], rows (si, j, bp)
+        sr = s * r
+        # row-fold: byte = sum_b (acc[8x+b] & 1) << b — stays int32,
+        # narrows only the [SR, T] result
+        folded = acc.reshape(sr, 8, t)
+        out = jnp.zeros((sr, t), jnp.int32)
+        for b in range(8):
+            out = out | ((folded[:, b, :] & jnp.int32(1)) << jnp.int32(b))
+        out_ref[:] = out.astype(jnp.uint8).reshape(s, r, t)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("c", "r", "s", "pad", "tile")
+)
+def _apply(bmat_big, data, c, r, s, pad, tile):
+    batch, _, n = data.shape
+    return pl.pallas_call(
+        _make_kernel(c, r, s, pad),
+        grid=(batch // s, n // tile),
+        in_specs=[
+            pl.BlockSpec(bmat_big.shape, lambda b, ch: (0, 0)),
+            pl.BlockSpec((s, c, tile), lambda b, ch: (b, 0, ch)),
+        ],
+        out_specs=pl.BlockSpec((s, r, tile), lambda b, ch: (b, 0, ch)),
+        out_shape=jax.ShapeDtypeStruct((batch, r, n), jnp.uint8),
+    )(bmat_big, data)
+
+
+def main():
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    m = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    g = vandermonde_rs_matrix(k, m)
+    bm = gf_matrix_to_bitmatrix(g[k:, :])
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (BATCH, k, CHUNK), np.uint8))
+    small = jnp.asarray(rng.integers(0, 256, (8, k, 8192), np.uint8))
+    ref = np.asarray(gf_encode_bitplane(jnp.asarray(bm), small))
+
+    s, pad = pe._pick_stripes(k, BATCH)
+    for tile in (65536, 32768):
+        big = jnp.asarray(_rowfold_matrix(bm, k, m, s, pad))
+        got = np.asarray(_apply(big, small, k, m, s, pad, 2048))
+        ok = np.array_equal(got, ref)
+        if not ok:
+            print(f"rowfold s{s} pad{pad}: WRONG"); return
+        gb = _gbps(lambda d: _apply(big, d, k, m, s, pad, tile), data, k)
+        print(f"rowfold s{s} F={s*k+pad} tile={tile//1024}k: {gb:.1f} GB/s",
+              flush=True)
+    print(f"shipped: {_gbps(lambda d: pe.gf_encode_bitplane_pallas(bm, d), data, k):.1f} GB/s",
+          flush=True)
+    print(f"shipped rep2: {_gbps(lambda d: pe.gf_encode_bitplane_pallas(bm, d), data, k):.1f} GB/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

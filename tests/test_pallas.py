@@ -73,3 +73,36 @@ def test_supported_predicate():
     assert supported((1, 4, LANE_TILE * 3))
     assert not supported((8, LANE_TILE))          # missing batch dim
     assert not supported((2, 8, LANE_TILE + 128))  # untileable chunk
+
+
+def test_traced_then_eager_encode_no_tracer_leak(rng):
+    """A stationary-matrix cache entry created under one jit trace
+    must not poison later traces or eager calls (the lru_cache-of-
+    device-arrays leak: caching jnp.asarray output from inside a
+    trace hands every later caller a dead tracer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
+    from ceph_tpu.ops import pallas_encode as pe
+
+    g = vandermonde_rs_matrix(3, 2)  # a shape no other test uses
+    bm = gf_matrix_to_bitmatrix(g[3:, :])
+    data = jnp.asarray(rng.integers(0, 256, (4, 3, 4096), np.uint8))
+
+    @jax.jit
+    def traced(d):
+        return pe.gf_encode_bitplane_pallas(bm, d, interpret=True)
+
+    first = np.asarray(traced(data))          # cache fills under trace
+
+    @jax.jit
+    def traced2(d):                            # a SECOND trace hits it
+        return pe.gf_encode_bitplane_pallas(bm, d, interpret=True)
+
+    second = np.asarray(traced2(data))
+    eager = np.asarray(
+        pe.gf_encode_bitplane_pallas(bm, data, interpret=True)
+    )
+    np.testing.assert_array_equal(first, second)
+    np.testing.assert_array_equal(first, eager)
