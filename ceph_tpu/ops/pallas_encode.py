@@ -123,6 +123,28 @@ def _v3_matrix_cached(
     return _v3_matrix(mat, c8 // 8, r8 // 8, s, pad)
 
 
+#: second-level DEVICE cache for eager callers — populated ONLY with
+#: concrete arrays (never under a trace), bounded like the np cache
+_V3_DEV: "OrderedDict[tuple, jax.Array]" = None  # type: ignore
+
+
+def _v3_dev_cached(key: tuple, big_np: np.ndarray):
+    global _V3_DEV
+    from collections import OrderedDict
+
+    if _V3_DEV is None:
+        _V3_DEV = OrderedDict()
+    dev = _V3_DEV.get(key)
+    if dev is None:
+        dev = jnp.asarray(big_np)
+        _V3_DEV[key] = dev
+        if len(_V3_DEV) > 128:
+            _V3_DEV.popitem(last=False)
+    else:
+        _V3_DEV.move_to_end(key)
+    return dev
+
+
 def _pick_stripes(c: int, batch: int) -> tuple[int, int]:
     """(stripes-per-block, pad-rows) — the high-k packing rule.
 
@@ -292,7 +314,14 @@ def gf_encode_bitplane_pallas(
     if c8 != c * 8:
         raise ValueError(f"bitmatrix cols {c8} != shards*8 {c * 8}")
     s, pad = _pick_stripes(c, batch)
-    big = _v3_matrix_cached(mat.tobytes(), r8, c8, s, pad)
+    key = (mat.tobytes(), r8, c8, s, pad)
+    big = _v3_matrix_cached(*key)
+    if not isinstance(data, jax.core.Tracer):
+        # eager calls keep a CONCRETE device copy so the stationary
+        # matrix uploads once, not per call; traced calls embed the
+        # numpy constant in their own trace (caching a device array
+        # built under a trace is the tracer-leak this split avoids)
+        big = _v3_dev_cached(key, big)
     r = r8 // 8
     tile = _pick_lane_tile(n)
     # VMEM pressure scales with the contraction width (8 * (S*C+pad)

@@ -292,6 +292,14 @@ class DcnCluster:
         self.hellos: dict[int, object] = {}
         self._replies: dict[tuple[int, int], object] = {}
         self._lock = threading.Lock()
+        #: serializes WHOLE ops (send fan-out through reply wait):
+        #: every op bottoms out in a cross-host SPMD collective, which
+        #: requires all hosts to execute ops in the SAME order —
+        #: interleaved sends from concurrent threads give the hosts
+        #: divergent orders and their collectives pair wrongly (hangs
+        #: observed under a 12-thread stress test). Workers execute
+        #: serially anyway, so this lock costs no real parallelism.
+        self._op_lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._tid = 0
         self.msgr = None
@@ -463,6 +471,10 @@ class DcnCluster:
              data: np.ndarray, meta_extra: dict | None = None):
         """Broadcast one op: identical metadata to every host, each
         host carrying its own sp-block of the shard axis."""
+        with self._op_lock:
+            return self._run_locked(kind, plugin, profile, data, meta_extra)
+
+    def _run_locked(self, kind, plugin, profile, data, meta_extra=None):
         from ceph_tpu.msg.messages import DcnCmd
 
         b, c, n = data.shape
@@ -522,6 +534,10 @@ class DcnCluster:
         over DCN). Shorter timeout than the command ops: this sits on
         the data path, where a dead host should fail fast into the
         dispatcher's fallback."""
+        with self._op_lock:
+            return self._apply_bitmatrix_locked(bm_np, data, timeout)
+
+    def _apply_bitmatrix_locked(self, bm_np, data, timeout):
         from ceph_tpu.msg.messages import DcnCmd
 
         b0, c, n0 = data.shape

@@ -50,8 +50,6 @@ def _rowfold_matrix(bitmatrix: np.ndarray, c: int, r: int, s: int, pad: int):
 
 
 def _make_kernel(c, r, s, pad):
-    from jax.experimental.pallas import tpu as pltpu
-
     def kernel(bmat_ref, data_ref, out_ref):
         d = data_ref[:]
         t = d.shape[2]
@@ -106,12 +104,11 @@ def main():
     ref = np.asarray(gf_encode_bitplane(jnp.asarray(bm), small))
 
     s, pad = pe._pick_stripes(k, BATCH)
+    big = jnp.asarray(_rowfold_matrix(bm, k, m, s, pad))
+    got = np.asarray(_apply(big, small, k, m, s, pad, 2048))
+    if not np.array_equal(got, ref):
+        print(f"rowfold s{s} pad{pad}: WRONG"); return
     for tile in (65536, 32768):
-        big = jnp.asarray(_rowfold_matrix(bm, k, m, s, pad))
-        got = np.asarray(_apply(big, small, k, m, s, pad, 2048))
-        ok = np.array_equal(got, ref)
-        if not ok:
-            print(f"rowfold s{s} pad{pad}: WRONG"); return
         gb = _gbps(lambda d: _apply(big, d, k, m, s, pad, tile), data, k)
         print(f"rowfold s{s} F={s*k+pad} tile={tile//1024}k: {gb:.1f} GB/s",
               flush=True)
