@@ -307,11 +307,29 @@ class IoCtx:
         ).size
 
     def write_full(self, oid: str, data: bytes) -> int:
-        try:
-            self.remove(oid)
-        except FileNotFoundError:
-            pass
-        return self.write(oid, data, 0)
+        """Replace the object with exactly ``data``
+        (rados_write_full): one primary-side op — write + shrink under
+        the daemon's op lock, so no other client observes a
+        half-replaced object (the old remove+write sugar had a
+        no-object window)."""
+        return self.objecter.submit(
+            self.pool, oid, "writefull", data=bytes(data)
+        ).size
+
+    def append(self, oid: str, data: bytes) -> int:
+        """Append at the current size (rados_append): the offset
+        resolves on the primary under its op lock, so concurrent
+        appends serialize without overlap."""
+        return self.objecter.submit(
+            self.pool, oid, "append", data=bytes(data)
+        ).size
+
+    def truncate(self, oid: str, size: int) -> int:
+        """Resize (rados_trunc): shrink cuts, grow reads back as
+        zeros (hole semantics)."""
+        return self.objecter.submit(
+            self.pool, oid, "truncate", offset=size
+        ).size
 
     def read(
         self,

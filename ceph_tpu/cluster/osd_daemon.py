@@ -76,7 +76,8 @@ from .osdmap import OSDMap, SHARD_NONE
 
 #: ops whose re-application a lost-reply resend must not repeat
 _MUTATING_OPS = frozenset(
-    {"write", "remove", "setxattr", "rmxattr", "omapset", "rollback"}
+    {"write", "remove", "setxattr", "rmxattr", "omapset", "rollback",
+     "append", "truncate", "writefull"}
 )
 
 
@@ -111,6 +112,31 @@ def snap_of_loc(loc: str) -> int:
     """Clone's snapid, 0 for a head object."""
     parts = loc.split(SNAP_SEP, 1)
     return int(parts[1]) if len(parts) == 2 else 0
+
+
+
+
+#: replicated reqid-dedup window attr (the pg-log reqid role,
+#: osd_types.h osd_reqid_t + PGLog dedup): the last few mutating
+#: reqids and their result sizes travel on every shard txn, so a NEW
+#: primary after failover can replay a resent op's result instead of
+#: re-applying it (appends would otherwise duplicate)
+REQ_KEY = "rq"
+REQ_WINDOW = 8
+
+
+def pack_reqs(window: "list[tuple[str, int]]") -> bytes:
+    return ";".join(f"{r},{s}" for r, s in window[-REQ_WINDOW:]).encode()
+
+
+def parse_reqs(raw: bytes) -> "list[tuple[str, int]]":
+    out = []
+    for part in raw.decode().split(";"):
+        if not part:
+            continue
+        r, _, s = part.rpartition(",")
+        out.append((r, int(s)))
+    return out
 
 
 def shard_key(loc: str, shard: int) -> str:
@@ -386,6 +412,9 @@ class OSDDaemon:
         # re-applying (remove would otherwise surface enoent for a
         # successful op). Bounded FIFO; guarded by _op_lock.
         self._completed_ops: "OrderedDict[str, OSDOpReply]" = OrderedDict()
+        #: loc -> [(reqid, size)] rolling window mirroring the
+        #: replicated REQ_KEY attr (seeded from storage on takeover)
+        self._req_windows: dict[str, list] = {}
         self._completed_cap = 1024
         self._stopped = False
         # -- background scrub scheduling (osd/scrubber/osd_scrub.cc):
@@ -1136,6 +1165,14 @@ class OSDDaemon:
                         msg.tid, epoch, error=cached.error,
                         size=cached.size, data=cached.data,
                     )
+                # failover path: the replicated per-object window (the
+                # pg-log reqid role) survives the old primary — a
+                # resent append/write/truncate replays its recorded
+                # result instead of re-applying
+                pg0 = self._get_pg(msg.pool, pgid)
+                for rq, size in self._req_window(pg0, msg.oid):
+                    if rq == msg.reqid:
+                        return OSDOpReply(msg.tid, epoch, size=size)
             pg = self._get_pg(msg.pool, pgid)
             if msg.op in _MUTATING_OPS:
                 # copy-on-first-write after a pool snapshot: the head
@@ -1145,6 +1182,36 @@ class OSDDaemon:
                 self._maybe_cow(pg, spec, msg.oid)
             if msg.op == "write":
                 return self._record_completed(msg, self._op_write(pg, msg))
+            if msg.op == "append":
+                # atomic under _op_lock: offset resolves to the
+                # CURRENT size, so concurrent appends serialize
+                # without overlap (rados_append)
+                msg.offset = self._object_size(pg, msg.oid)
+                return self._record_completed(msg, self._op_write(pg, msg))
+            if msg.op == "truncate":
+                return self._record_completed(
+                    msg, self._op_truncate(pg, msg)
+                )
+            if msg.op == "writefull":
+                # write-then-shrink under one lock scope: the object
+                # is exactly the payload afterwards (rados_write_full).
+                # The reqid window stamps ONLY the final sub-op: a
+                # crash between the two would otherwise make every
+                # resend replay the half-applied state (stale tail
+                # never cut); with the write unstamped, the resend
+                # re-runs both halves — idempotent.
+                saved_reqid = msg.reqid
+                msg.reqid = ""
+                try:
+                    reply = self._op_write(pg, msg)
+                finally:
+                    msg.reqid = saved_reqid
+                if reply.error:
+                    return self._record_completed(msg, reply)
+                msg.offset = len(msg.data)
+                return self._record_completed(
+                    msg, self._op_truncate(pg, msg)
+                )
             if msg.op == "rollback":
                 return self._record_completed(
                     msg, self._op_rollback(pg, spec, msg)
@@ -1185,11 +1252,57 @@ class OSDDaemon:
                 self._completed_ops.popitem(last=False)
         return reply
 
+    def _req_window(self, pg: _PG, loc: str) -> list:
+        """This object's reqid window, seeding from the stored attr
+        the first time (the takeover path: a new primary reads what
+        the old one replicated)."""
+        win = self._req_windows.get(loc)
+        if win is None:
+            win = []
+            key = self._my_key(pg, loc)
+            if key is not None:
+                try:
+                    win = parse_reqs(self.store.getattr(key, REQ_KEY))
+                except (FileNotFoundError, KeyError, ValueError):
+                    pass
+            if len(self._req_windows) > 4096:
+                self._req_windows.pop(next(iter(self._req_windows)))
+            self._req_windows[loc] = win
+        return win
+
+    def _req_attr_for(self, pg: _PG, loc: str, reqid: str,
+                      size: int) -> "dict[str, bytes] | None":
+        """extra_attrs carrying the window INCLUDING this op — stamped
+        into the op's own shard txns, atomically replicated with it.
+        PURE: the in-memory window only updates via _req_commit once
+        the op actually commits — a failed op's reqid must never be
+        replayable as a success."""
+        if not reqid:
+            return None
+        win = [t for t in self._req_window(pg, loc) if t[0] != reqid]
+        win.append((reqid, size))
+        del win[:-REQ_WINDOW]
+        return {REQ_KEY: pack_reqs(win)}
+
+    def _req_commit(self, pg: _PG, loc: str, reqid: str,
+                    size: int) -> None:
+        if not reqid:
+            return
+        win = [t for t in self._req_window(pg, loc) if t[0] != reqid]
+        win.append((reqid, size))
+        del win[:-REQ_WINDOW]
+        self._req_windows[loc] = win
+
     def _op_write(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
-        self._object_size(pg, msg.oid)  # prime from attrs on takeover
+        cur = self._object_size(pg, msg.oid)  # prime attrs on takeover
+        result_size = max(cur, msg.offset + len(msg.data))
         done: list = []
         pg.rmw.submit(
-            msg.oid, msg.offset, msg.data, on_commit=lambda op: done.append(op)
+            msg.oid, msg.offset, msg.data,
+            on_commit=lambda op: done.append(op),
+            extra_attrs=self._req_attr_for(
+                pg, msg.oid, msg.reqid, result_size
+            ),
         )
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
@@ -1198,12 +1311,38 @@ class OSDDaemon:
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
             )
+        self._req_commit(pg, msg.oid, msg.reqid, result_size)
         if pg.backfilling:
             with self._pg_lock:
                 pg.backfill_dirty.add(msg.oid)  # re-pushed pre-cutover
         return OSDOpReply(
             msg.tid, self.osdmap.epoch, size=pg.rmw.object_size(msg.oid)
         )
+
+    def _op_truncate(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
+        """rados_trunc: msg.offset carries the new size. Rides the
+        RMW pipeline's per-object FIFO so it serializes with in-flight
+        writes."""
+        self._object_size(pg, msg.oid)  # prime from attrs on takeover
+        done: list = []
+        pg.rmw.submit_truncate(
+            msg.oid, msg.offset, on_commit=lambda op: done.append(op),
+            extra_attrs=self._req_attr_for(
+                pg, msg.oid, msg.reqid, msg.offset
+            ),
+        )
+        pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
+        op = done[0]
+        if op.error is not None:
+            return OSDOpReply(
+                msg.tid, self.osdmap.epoch, error="eio",
+                data=str(op.error).encode(),
+            )
+        self._req_commit(pg, msg.oid, msg.reqid, msg.offset)
+        if pg.backfilling:
+            with self._pg_lock:
+                pg.backfill_dirty.add(msg.oid)
+        return OSDOpReply(msg.tid, self.osdmap.epoch, size=msg.offset)
 
     def _op_read(self, pg: _PG, msg: OSDOp) -> OSDOpReply:
         if not self._object_exists(pg, msg.oid):

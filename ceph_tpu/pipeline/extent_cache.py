@@ -145,12 +145,34 @@ class ECExtentCache:
             self._ops.pop(op.oid, None)
         self._evict()
         self._progress()
+        # reads queued while this op held the FIFO (e.g. a truncate's
+        # invalidation re-queuing a former cache hit) issue now
+        self._maybe_issue_read()
 
     def on_change(self) -> None:
         """Drop everything not pinned (PG interval change analog)."""
         self._read_queue.clear()
         self._active_read = None
         self._evict(force_all=True)
+
+    def invalidate_object(self, oid: str) -> None:
+        """Drop one object's cached CONTENT (truncate invalidation):
+        later ops re-read from the backend. Pins/line bookkeeping
+        stay — they only gate eviction. Ops already queued as HITS
+        must re-enter the read queue, or they would wait forever for
+        extents nothing will produce; the read issues only after the
+        invalidating op's write_done, so it sees post-truncate
+        stores."""
+        self._data.pop(oid, None)
+        self._present.pop(oid, None)
+        for op in self._ops.get(oid, []):
+            if (
+                not op.invoked
+                and not op.done
+                and op not in self._read_queue
+                and self._missing(op)
+            ):
+                self._read_queue.append(op)
 
     # -- internals ------------------------------------------------------
     def _present_set(self, oid: str, shard: int) -> ExtentSet:

@@ -53,13 +53,20 @@ class PGLog:
     def append(
         self, tid: int, oid: str, shard_extents: dict[int, ExtentSet],
         epoch: int = 0,
+        xattrs: "dict[str, bytes | None] | None" = None,
     ) -> None:
+        """``xattrs`` may carry identity attrs (OI/HINFO) alongside
+        the extents: a shard that misses a size-changing op (truncate,
+        grow) with no replayable extents still needs the new OI — a
+        stale size on a later primary takeover would clip the
+        object."""
         if self.entries and tid <= self.entries[-1].tid:
             raise ValueError(f"non-monotonic log append: tid {tid}")
         self.entries.append(
             LogEntry(
                 tid, oid,
                 {s: es.copy() for s, es in shard_extents.items()},
+                xattrs=dict(xattrs) if xattrs else None,
                 epoch=epoch,
             )
         )

@@ -210,6 +210,7 @@ class ClientOp:
         self.cache_op: CacheOp | None = None
         self.pending_shards: set[int] = set()
         self.acked_shards: set[int] = set()
+        self.extra_attrs: "dict[str, bytes] | None" = None
         self.written: "ShardExtentMap | None" = None
         self.committed = False
         self.notified = False
@@ -358,6 +359,12 @@ class RMWPipeline:
         self._next_tid = 1
         self._inflight: "OrderedDict[int, ClientOp]" = OrderedDict()
         self._object_sizes: dict[str, int] = {}
+        #: size as of the LAST SUBMITTED op (dispatch updates
+        #: _object_sizes later): decisions made at submit time about
+        #: a racing in-flight op's outcome — the truncate boundary
+        #: re-encode — must use the projected view, not the
+        #: dispatch-time one
+        self._projected_sizes: dict[str, int] = {}
         self._hinfo: dict[str, HashInfo] = {}
         #: current map epoch, stamped (with the op tid) into every
         #: write's OI attr as the object's eversion; the owning daemon
@@ -402,8 +409,14 @@ class RMWPipeline:
         ro_offset: int,
         data: bytes,
         on_commit: Callable[[ClientOp], None] | None = None,
+        extra_attrs: "dict[str, bytes] | None" = None,
     ) -> int:
+        """``extra_attrs`` ride every shard txn of this op (the
+        daemon's replicated reqid-dedup window travels here, so a
+        resend after primary failover can be replayed instead of
+        re-applied — the pg-log reqid role)."""
         op = ClientOp(self._next_tid, oid, ro_offset, bytes(data), on_commit)
+        op.extra_attrs = dict(extra_attrs) if extra_attrs else None
         op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
@@ -430,6 +443,12 @@ class RMWPipeline:
 
         from ceph_tpu.utils import tracer
 
+        self._projected_sizes[oid] = max(
+            self._projected_sizes.get(
+                oid, self._object_sizes.get(oid, 0)
+            ),
+            ro_offset + len(data),
+        )
         with tracer.span("ec_write", oid=oid, tid=op.tid, bytes=len(data)):
             object_size = self._object_sizes.get(oid, 0)
             op.plan = plan_write(
@@ -462,6 +481,7 @@ class RMWPipeline:
         cache FIFO as writes (a remove racing an in-flight write must
         apply after it) and journaled in the pg log so a down shard
         cannot resurrect the object on recovery."""
+        self._projected_sizes.pop(oid, None)
         op = ClientOp(self._next_tid, oid, 0, b"", on_commit)
         op.t_submit = time.perf_counter()
         self._next_tid += 1
@@ -484,6 +504,113 @@ class RMWPipeline:
                     self.backend.submit_shard_txn(
                         shard,
                         Transaction().touch(oid).remove(oid),
+                        lambda s=shard, o=_op: self._shard_ack(o, s),
+                    )
+            except Exception as e:
+                self._abort_op(_op, e)
+
+        op.cache_op = self.cache.prepare(oid, {}, {}, 0, dispatch)
+        self.cache.execute([op.cache_op])
+        return op.tid
+
+    def submit_truncate(
+        self,
+        oid: str,
+        new_size: int,
+        on_commit: Callable[[ClientOp], None] | None = None,
+        extra_attrs: "dict[str, bytes] | None" = None,
+    ) -> int:
+        """rados_trunc: resize the object, ordered through the
+        per-object cache FIFO like writes. Shrink cuts every shard at
+        its exact size (the zero-padding convention must be REAL: a
+        later extend-write elides reads past the recorded size, so
+        stale tail bytes would silently corrupt parity) and clears the
+        cumulative HashInfo like an overwrite; grow just raises the
+        recorded size — the gap reads as zeros, rados' hole
+        semantics. The pg log journals the cut region so a down shard
+        replays it (survivors decode the zero-padded tail to zeros).
+
+        A ragged shrink first writes ZEROS over the boundary stripe's
+        tail through the normal RMW path: parity still encodes the
+        old bytes there, and cutting the data shards without
+        re-encoding would leave the stripe inconsistent (a degraded
+        read would decode the pre-truncate content back to life)."""
+        old_size_now = self._projected_sizes.get(
+            oid, self._object_sizes.get(oid, 0)
+        )
+        if new_size < old_size_now:
+            sw = self.sinfo.stripe_width
+            boundary_end = min(-(-new_size // sw) * sw, old_size_now)
+            if boundary_end > new_size:
+                self.submit(
+                    oid, new_size, b"\0" * (boundary_end - new_size)
+                )
+        # the projection lands AFTER the boundary zero-write's own
+        # submit raised it — the post-truncate size is the cut
+        self._projected_sizes[oid] = new_size
+        op = ClientOp(self._next_tid, oid, 0, b"", on_commit)
+        op.t_submit = time.perf_counter()
+        self._next_tid += 1
+        self._inflight[op.tid] = op
+        sinfo = self.sinfo
+
+        def dispatch(cop, _op=op) -> None:
+            try:
+                live = set(self.backend.avail_shards())
+                if len(live) < sinfo.k:
+                    raise IOError(
+                        f"only {len(live)} shards available, need {sinfo.k}"
+                    )
+                old_size = self._object_sizes.get(oid, 0)
+                self._object_sizes[oid] = new_size
+                ev = (self.epoch, _op.tid)
+                self._eversions[oid] = ev
+                self._live_eversions[oid] = ev
+                hinfo = self._get_hinfo(oid)
+                if new_size < old_size:
+                    hinfo.clear()
+                hinfo_bytes = hinfo.to_bytes()
+                cut: dict[int, ExtentSet] = {}
+                txns: list[tuple[int, Transaction]] = []
+                for raw in range(sinfo.k + sinfo.m):
+                    shard = sinfo.get_shard(raw)
+                    new_exact = sinfo.object_size_to_exact_shard_size(
+                        new_size, shard
+                    )
+                    old_exact = sinfo.object_size_to_exact_shard_size(
+                        old_size, shard
+                    )
+                    if old_exact > new_exact:
+                        cut[shard] = ExtentSet(
+                            [(new_exact, old_exact)]
+                        )
+                    txn = self._stamp_identity(
+                        Transaction().touch(oid).truncate(oid, new_exact),
+                        oid, shard, new_size, ev, hinfo_bytes,
+                        extra_attrs,
+                    )
+                    txns.append((shard, txn))
+                if self.pglog is not None:
+                    # identity attrs journal WITH the cut: a shard
+                    # down for a grow (cut == {}) still replays the
+                    # new size, or a later takeover on it would clip
+                    # the object back to the pre-truncate length
+                    self.pglog.append(
+                        _op.tid, oid, cut, epoch=self.epoch,
+                        xattrs=self._journal_attrs(
+                            new_size, ev, hinfo_bytes, extra_attrs
+                        ),
+                    )
+                # stale tail content must leave the cache before any
+                # later op snapshots it
+                self.cache.invalidate_object(oid)
+                _op.pending_shards = set(live)
+                _op.written = ShardExtentMap(sinfo)
+                for shard, txn in txns:
+                    if shard not in live:
+                        continue  # hole: journaled; recovered later
+                    self.backend.submit_shard_txn(
+                        shard, txn,
                         lambda s=shard, o=_op: self._shard_ack(o, s),
                     )
             except Exception as e:
@@ -683,8 +810,22 @@ class RMWPipeline:
                 if hashed:
                     hinfo.clear()
 
-        self._generate_transactions(op, new_map, new_size)
+        # size publishes BEFORE the dispatch: synchronous sub-write
+        # acks can complete this op and cascade the NEXT queued op's
+        # dispatch from inside _generate_transactions — assigning
+        # afterwards would clobber whatever that nested op set (a
+        # truncate queued behind a write lost its cut this way). On
+        # dispatch failure the op aborts, so the size rolls back.
+        prev = self._object_sizes.get(op.oid)
         self._object_sizes[op.oid] = new_size
+        try:
+            self._generate_transactions(op, new_map, new_size)
+        except BaseException:
+            if prev is None:
+                self._object_sizes.pop(op.oid, None)
+            else:
+                self._object_sizes[op.oid] = prev
+            raise
         self._eversions[op.oid] = (self.epoch, op.tid)
         self._live_eversions[op.oid] = (self.epoch, op.tid)
 
@@ -727,18 +868,25 @@ class RMWPipeline:
                 buf = bytes(result.get(shard, start, end - start))
                 txn.write(op.oid, start, buf)
                 written.insert(shard, start, np.frombuffer(buf, np.uint8))
-            txn.setattr(op.oid, HINFO_KEY, hinfo_bytes)
-            txn.setattr(
-                op.oid, OI_KEY, pack_oi(new_size, (self.epoch, op.tid))
+            self._stamp_identity(
+                txn, op.oid, shard, new_size,
+                (self.epoch, op.tid), hinfo_bytes, op.extra_attrs,
             )
-            txn.setattr(op.oid, SI_KEY, str(shard).encode())
             txns.append((shard, txn))
         if self.pglog is not None:
+            # OI/HINFO ride every entry so the xattr-replay's merged
+            # final state never regresses them to an older op's
+            # values (a truncate's journaled size must not outlive a
+            # later write's)
             self.pglog.append(
                 op.tid,
                 op.oid,
                 {s: written.get_extent_set(s) for s in written.shards()},
                 epoch=self.epoch,
+                xattrs=self._journal_attrs(
+                    new_size, (self.epoch, op.tid), hinfo_bytes,
+                    op.extra_attrs,
+                ),
             )
         # build every txn before the first dispatch: a synchronous ack
         # (local stores) must see the complete written map
@@ -748,6 +896,40 @@ class RMWPipeline:
             self.backend.submit_shard_txn(
                 shard, txn, lambda s=shard, o=op: self._shard_ack(o, s)
             )
+
+
+    # -- shared identity plumbing (write + truncate txns) --------------
+    @staticmethod
+    def _stamp_identity(
+        txn: Transaction, oid: str, shard: int, size: int,
+        ev: "tuple[int, int]", hinfo_bytes: bytes,
+        extra_attrs: "dict[str, bytes] | None",
+    ) -> Transaction:
+        """The per-shard identity-attr suffix every mutating txn
+        carries — ONE implementation so the write and truncate paths
+        cannot diverge (OI/HINFO/SI plus caller extras like the
+        replicated reqid window)."""
+        txn.setattr(oid, HINFO_KEY, hinfo_bytes)
+        txn.setattr(oid, OI_KEY, pack_oi(size, ev))
+        txn.setattr(oid, SI_KEY, str(shard).encode())
+        for aname, aval in (extra_attrs or {}).items():
+            txn.setattr(oid, aname, aval)
+        return txn
+
+    @staticmethod
+    def _journal_attrs(
+        size: int, ev: "tuple[int, int]", hinfo_bytes: bytes,
+        extra_attrs: "dict[str, bytes] | None",
+    ) -> "dict[str, bytes]":
+        """The xattrs journaled with each entry so a shard that missed
+        the op replays the SAME identity state the txns carried —
+        including the reqid window (a recovered shard that later hosts
+        the primary must not lose failover dedup)."""
+        return {
+            OI_KEY: pack_oi(size, ev),
+            HINFO_KEY: hinfo_bytes,
+            **(extra_attrs or {}),
+        }
 
     def _shard_ack(self, op: ClientOp, shard: int) -> None:
         finish = False

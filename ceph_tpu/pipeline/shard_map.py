@@ -297,6 +297,31 @@ class ShardExtentMap:
         if hull is None or hull[1] <= hull[0]:
             return
         lo, hi = hull
+        # a wanted shard that STORES nothing in the window (short
+        # object / post-truncate tail) needs no reconstruction — its
+        # bytes are zeros by convention; demanding k survivors for it
+        # would fail exactly when the object is small. It must still
+        # MATERIALIZE as zeros here: callers (the RMW extent cache)
+        # check that requested extents became present, and an absent
+        # shard would re-issue the backend read forever.
+        zero_raw = [
+            raw for raw in missing_raw
+            if sinfo.object_size_to_exact_shard_size(
+                object_size, sinfo.get_shard(raw)
+            ) <= lo
+        ]
+        for raw in zero_raw:
+            shard = sinfo.get_shard(raw)
+            end = min(
+                hi, sinfo.object_size_to_shard_size(object_size, shard)
+            )
+            if end > lo:
+                self.insert(
+                    shard, lo, np.zeros(end - lo, dtype=np.uint8)
+                )
+        missing_raw = [r for r in missing_raw if r not in zero_raw]
+        if not missing_raw:
+            return
         # Survivors must cover the stored part of the window: a shard
         # holding only a sub-range would decode zero-filled gaps into
         # the output (absent bytes are zero ONLY beyond shard size).
@@ -310,6 +335,19 @@ class ShardExtentMap:
             end = min(hi, ssize)
             if end <= lo or self.get_extent_set(shard).contains(lo, end - lo):
                 present_raw.append(sinfo.get_raw_shard(shard))
+        # a shard NOT in the map whose stored size ends at/before the
+        # window is a KNOWN-ZERO survivor (short object / truncated
+        # tail): its window content is zeros by convention, and
+        # counting it can be the difference between decodable and not
+        # (e.g. two lost shards + one empty shard in a k=4 stripe)
+        for raw in range(sinfo.k + sinfo.m):
+            shard = sinfo.get_shard(raw)
+            if shard in self._bufs or raw in missing_raw:
+                continue
+            if sinfo.object_size_to_exact_shard_size(
+                object_size, shard
+            ) <= lo:
+                present_raw.append(raw)
         present_raw.sort()
         n_chunks = (hi - lo) // cs
         chunks = {

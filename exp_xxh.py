@@ -1,0 +1,153 @@
+"""xxhash scan-layout experiment (round 4): the shipping kernels feed
+lax.scan a [G, B, f*S] operand built by reshape+swapaxes — which can
+materialize a transposed full-size copy through HBM (2x traffic).
+Variant: fori_loop + dynamic_slice_in_dim on the ORIGINAL [B, L]
+layout (no transpose). Same math, same unroll.
+
+Usage: PYTHONPATH=/root/repo python exp_xxh.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ceph_tpu.checksum.xxhash as xx
+import ceph_tpu.checksum.u64 as u64
+from bench import _hash_loop_gbps
+
+
+def xxh32_slice_kernel(data, seed, *, block_bytes):
+    p1, p2, p3, p4, p5 = (jnp.uint32(p) for p in xx._P32)
+    n = block_bytes
+    bsz = data.shape[0]
+    seed = seed.astype(jnp.uint32)
+    assert n >= 16 and n % 16 == 0
+    nstripes = n // 16
+    init = jnp.broadcast_to(
+        jnp.stack([seed + p1 + p2, seed + p2, seed, seed - p1]),
+        (bsz, 4),
+    )
+    f, main = xx._unroll_split(nstripes)
+
+    def body(g, acc):
+        group = jax.lax.dynamic_slice_in_dim(
+            data, g * (f * 16), f * 16, axis=1
+        )
+        lanes = xx._le32(group.reshape(bsz, f, 4, 4))
+        for j in range(f):
+            acc = acc + lanes[:, j] * p2
+            acc = xx._rotl32(acc, 13) * p1
+        return acc
+
+    acc = jax.lax.fori_loop(0, main // f, body, init)
+    for s in range(main, nstripes):
+        lanes = xx._le32(data[:, s * 16 : (s + 1) * 16].reshape(bsz, 4, 4))
+        acc = acc + lanes * p2
+        acc = xx._rotl32(acc, 13) * p1
+    h = (
+        xx._rotl32(acc[:, 0], 1)
+        + xx._rotl32(acc[:, 1], 7)
+        + xx._rotl32(acc[:, 2], 12)
+        + xx._rotl32(acc[:, 3], 18)
+    )
+    h = h + jnp.uint32(n)
+    h = h ^ (h >> 15)
+    h = h * p2
+    h = h ^ (h >> 13)
+    h = h * p3
+    return h ^ (h >> 16)
+
+
+def xxh64_slice_kernel(data, *, block_bytes):
+    p1, p2, p3, p4, p5 = (u64.from_const(p) for p in xx._P64)
+    n = block_bytes
+    bsz = data.shape[0]
+    zero = (jnp.zeros((bsz,), jnp.uint32), jnp.zeros((bsz,), jnp.uint32))
+    seed = zero
+    assert n >= 32 and n % 32 == 0
+    nstripes = n // 32
+    init4 = [
+        u64.add(seed, u64.add(p1, p2)),
+        u64.add(seed, p2),
+        seed,
+        u64.add(seed, u64.from_const((-xx._P64[0]) & ((1 << 64) - 1))),
+    ]
+    init = (
+        jnp.stack([a[0] for a in init4], axis=-1),
+        jnp.stack([a[1] for a in init4], axis=-1),
+    )
+    f, main = xx._unroll_split(nstripes)
+
+    def body(g, acc):
+        group = jax.lax.dynamic_slice_in_dim(
+            data, g * (f * 32), f * 32, axis=1
+        )
+        hi, lo = xx._le64_pair(group.reshape(bsz, f, 4, 8))
+        for j in range(f):
+            acc = xx._xxh64_round(acc, (hi[:, j], lo[:, j]))
+        return acc
+
+    acc = jax.lax.fori_loop(0, main // f, body, init)
+    for s in range(main, nstripes):
+        hi, lo = xx._le64_pair(data[:, s * 32 : (s + 1) * 32].reshape(bsz, 4, 8))
+        acc = xx._xxh64_round(acc, (hi, lo))
+    accs = [(acc[0][:, j], acc[1][:, j]) for j in range(4)]
+    h = u64.add(
+        u64.add(u64.rotl(accs[0], 1), u64.rotl(accs[1], 7)),
+        u64.add(u64.rotl(accs[2], 12), u64.rotl(accs[3], 18)),
+    )
+    for j in range(4):
+        h = u64.xor(h, xx._xxh64_round(zero, accs[j]))
+        h = u64.add(u64.mul(h, p1), p4)
+    h = u64.add(h, u64.from_const(n))
+    h = u64.xor(h, u64.shr(h, 33))
+    h = u64.mul(h, p2)
+    h = u64.xor(h, u64.shr(h, 29))
+    h = u64.mul(h, p3)
+    return u64.xor(h, u64.shr(h, 32))
+
+
+def main():
+    rng = np.random.default_rng(3)
+    blocks = jnp.asarray(
+        rng.integers(0, 256, ((64 << 20) // 4096, 4096), np.uint8)
+    )
+    # correctness
+    from ceph_tpu.checksum.reference import xxh32_ref, xxh64_ref
+
+    small = np.asarray(rng.integers(0, 256, (3, 4096), np.uint8))
+    j32 = jax.jit(lambda d: xxh32_slice_kernel(
+        d, jnp.uint32(0), block_bytes=4096))
+    j64 = jax.jit(lambda d: xxh64_slice_kernel(d, block_bytes=4096))
+    g32 = np.asarray(j32(jnp.asarray(small)))
+    g64 = j64(jnp.asarray(small))
+    for i in range(3):
+        assert int(g32[i]) == xxh32_ref(small[i].tobytes()), i
+        have = (int(np.asarray(g64[0][i])) << 32) | int(np.asarray(g64[1][i]))
+        assert have == xxh64_ref(small[i].tobytes()), i
+    print("slice variants: correct", flush=True)
+
+    def x32s(b):
+        return j32(b)
+
+    def x64s(b):
+        h = j64(b)
+        return (h[0] ^ h[1]).astype(jnp.uint32)
+
+    def x32c(b):
+        return xx.xxh32_device(b)
+
+    def x64c(b):
+        h = xx.xxh64_device(b)
+        return (h[0] ^ h[1]).astype(jnp.uint32)
+
+    for name, fn in (("cur32", x32c), ("slice32", x32s),
+                     ("cur64", x64c), ("slice64", x64s),
+                     ("cur64b", x64c), ("slice64b", x64s)):
+        print(f"{name}: {_hash_loop_gbps(fn, blocks):.1f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

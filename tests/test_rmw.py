@@ -49,6 +49,12 @@ def reconstruct_object(pipe, sinfo, codec, oid, size, lost=()):
         if shard in lost or not store.exists(oid):
             continue
         buf = store.read(oid)
+        # mirror read_shard's zero-pad: stores may legitimately be
+        # shorter than the shard's exact size (holes after truncate +
+        # extend) — absent bytes are zeros by convention
+        exact = sinfo.object_size_to_exact_shard_size(size, shard)
+        if len(buf) < exact:
+            buf = buf + b"\0" * (exact - len(buf))
         smap.insert(shard, 0, np.frombuffer(buf, np.uint8))
     want = {sinfo.get_shard(r) for r in range(sinfo.k)}
     smap.decode(codec, want, size)
@@ -426,3 +432,107 @@ class TestBitMatrixParityDelta:
                 np.asarray(p_delta[j]), np.asarray(p_new[j]),
                 err_msg=f"parity shard {j}",
             )
+
+
+class TestTruncate:
+    """rados_trunc semantics through the RMW pipeline: shrink CUTS
+    shards (the zero-padding convention must be real — stale tail
+    bytes would corrupt a later extend's parity), grow reads back as
+    zeros, and everything stays reconstructible."""
+
+    def test_shrink_then_extend_reads_zero_gap(self, rng):
+        pipe, sinfo, codec, backend = make_pipeline()
+        data = rng.integers(0, 256, 2 * K * CHUNK, np.uint8).tobytes()
+        pipe.submit("obj", 0, data)
+        pipe.submit_truncate("obj", 3000)
+        assert pipe.object_size("obj") == 3000
+        got = reconstruct_object(pipe, sinfo, codec, "obj", 3000)
+        assert got == data[:3000]
+        # extend past the cut: the gap must be zeros, not stale bytes
+        tail = rng.integers(0, 256, 500, np.uint8).tobytes()
+        pipe.submit("obj", 8000, tail)
+        expect = data[:3000] + b"\0" * 5000 + tail
+        got = reconstruct_object(pipe, sinfo, codec, "obj", 8500)
+        assert got == expect
+        # degraded: decode through parity after the shrink+extend
+        got = reconstruct_object(
+            pipe, sinfo, codec, "obj", 8500, lost=(0, 1)
+        )
+        assert got == expect
+
+    def test_grow_is_a_hole(self, rng):
+        pipe, sinfo, codec, backend = make_pipeline()
+        data = rng.integers(0, 256, 1000, np.uint8).tobytes()
+        pipe.submit("obj", 0, data)
+        pipe.submit_truncate("obj", 5000)
+        assert pipe.object_size("obj") == 5000
+        got = reconstruct_object(pipe, sinfo, codec, "obj", 5000)
+        assert got == data + b"\0" * 4000
+
+    def test_truncate_journals_for_down_shard(self, rng):
+        """A shard down during the shrink replays the cut from the
+        log: survivors' zero-padded tails decode to zeros."""
+        pipe, sinfo, codec, backend = make_pipeline()
+        from ceph_tpu.pipeline.pglog import PGLog
+        from ceph_tpu.pipeline.recovery import RecoveryBackend
+
+        pglog = PGLog(K + M)
+        pipe = RMWPipeline(sinfo, codec, backend, pglog=pglog)
+        rec = RecoveryBackend(
+            sinfo, codec, backend, pipe.object_size, pipe.hinfo
+        )
+        data = rng.integers(0, 256, 2 * K * CHUNK, np.uint8).tobytes()
+        pipe.submit("obj", 0, data)
+        backend.down_shards.add(2)
+        pipe.submit_truncate("obj", 2000)
+        backend.down_shards.clear()
+        rec.recover_from_log(pglog, 2)
+        pipe.on_shard_recovered(2)
+        # force reads through shard 2
+        backend.down_shards.update({0, 1})
+        got = reconstruct_object(
+            pipe, sinfo, codec, "obj", 2000, lost=(0, 1)
+        )
+        assert got == data[:2000]
+
+    def test_grow_truncate_replays_size_to_down_shard(self, rng):
+        """A shard down during a GROW truncate (no cut extents) must
+        still learn the new size from the log — a later takeover on
+        that shard would otherwise clip the object."""
+        from ceph_tpu.pipeline.pglog import PGLog
+        from ceph_tpu.pipeline.recovery import RecoveryBackend
+        from ceph_tpu.pipeline.rmw import OI_KEY, parse_oi
+
+        _, sinfo, codec, backend = make_pipeline()
+        pglog = PGLog(K + M)
+        pipe = RMWPipeline(sinfo, codec, backend, pglog=pglog)
+        rec = RecoveryBackend(
+            sinfo, codec, backend, pipe.object_size, pipe.hinfo
+        )
+        pipe.submit("obj", 0, rng.integers(0, 256, 1000, np.uint8).tobytes())
+        backend.down_shards.add(3)
+        pipe.submit_truncate("obj", 9000)
+        backend.down_shards.clear()
+        rec.recover_from_log(pglog, 3)
+        pipe.on_shard_recovered(3)
+        size, _ev = parse_oi(backend.stores[3].getattr("obj", OI_KEY))
+        assert size == 9000, "down shard missed the grow's OI"
+
+    def test_truncate_racing_inflight_write_reencodes_boundary(self, rng):
+        """submit_truncate racing an in-flight extend must size its
+        boundary re-encode from the PROJECTED size (the write hasn't
+        dispatched yet), or parity keeps encoding the doomed bytes."""
+        pipe, sinfo, codec, backend = make_pipeline()
+        data = rng.integers(0, 256, 2 * K * CHUNK, np.uint8).tobytes()
+        backend.defer_acks = True
+        pipe.submit("obj", 0, data)        # in flight, not dispatched
+        pipe.submit_truncate("obj", 3000)  # must see projected 32768
+        backend.defer_acks = False
+        backend.release_deferred()
+        assert pipe.object_size("obj") == 3000
+        got = reconstruct_object(
+            pipe, sinfo, codec, "obj", 3000, lost=(0, 1)
+        )
+        assert got == data[:3000], (
+            "degraded read decoded pre-truncate bytes back to life"
+        )
