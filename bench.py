@@ -589,8 +589,35 @@ def _measure_checksums(result: dict) -> None:
         pass
 
 
+def _measure_tunnel_rtt(result: dict) -> None:
+    """Record the device round-trip latency alongside the numbers:
+    the remote tunnel degrades by 100x+ for hours at a time (observed
+    ~0.5 ms vs ~110 ms), and latency-class entries (smallop p99,
+    per-op paths) are only meaningful against a healthy RTT. The
+    throughput entries cancel RTT by design (trip-count
+    differencing), so they stay comparable either way."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.zeros((8, 8192), np.uint8))
+        # 1-byte readback: a full-array fetch would fold transfer
+        # bandwidth into the number and misread a healthy tunnel
+        f = jax.jit(lambda a: (a ^ 1)[0, :1])
+        np.asarray(f(x))  # warm
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(x))
+            samples.append(time.perf_counter() - t0)
+        result["tunnel_rtt_ms"] = round(min(samples) * 1e3, 2)
+    except Exception:
+        pass
+
+
 def main() -> None:
     result: dict = {}
+    _measure_tunnel_rtt(result)
     enc_gbps = _measure_device_path(result)
     _measure_baseline_configs(result)
     _measure_code_families(result)
