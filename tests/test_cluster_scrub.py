@@ -384,3 +384,24 @@ def test_scheduler_stamps_and_shallow_deep_cadence(cluster):
         assert hist and hist[1] == "shallow", hist
     finally:
         _restore_config(saved)
+
+
+def test_truncated_object_scrubs_clean_and_repairs(cluster):
+    """Deep scrub after a shrink + extend: the truncated object's
+    shards must verify clean, and injected bitrot on the surviving
+    content still repairs."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("tobj", payload(9_000, seed=41))
+    io.truncate("tobj", 2_500)
+    io.append("tobj", payload(800, seed=42))
+    (res,) = run_scrub(mon, daemons, "tobj")
+    assert res.ok, f"clean truncated object reported {res.errors}"
+    corrupt_shard(mon, daemons, "tobj", 1)
+    (res,) = run_scrub(mon, daemons, "tobj", repair=True)
+    assert res.errors, "scrub missed bitrot on a truncated object"
+    (res,) = run_scrub(mon, daemons, "tobj")
+    assert res.ok, f"repair left errors: {res.errors}"
+    assert io.read("tobj") == (
+        payload(9_000, seed=41)[:2_500] + payload(800, seed=42)
+    )

@@ -263,3 +263,21 @@ def test_pgls_hides_clones(cluster):
     io.snap_create("s1")
     io.write_full("obj", payload(1_000, seed=35))  # creates a clone
     assert set(io.list_objects()) == {"obj"}
+
+
+def test_rollback_across_truncate(cluster):
+    """Snapshot COW fires for truncate like any mutation: rollback
+    restores the pre-truncate bytes, size included."""
+    mon, daemons, client = cluster
+    io = client.open_ioctx("snappool")
+    v1 = payload(6_000, seed=21)
+    io.write("tr", v1)
+    io.snap_create("strunc")
+    io.truncate("tr", 1_000)
+    io.append("tr", payload(200, seed=22))
+    assert io.stat("tr") == 1_200
+    # the snap still serves the original
+    assert io.read("tr", snap="strunc") == v1
+    io.snap_rollback("tr", "strunc")
+    assert io.stat("tr") == 6_000
+    assert io.read("tr") == v1
