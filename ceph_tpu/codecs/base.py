@@ -110,21 +110,29 @@ class ErasureCodeBase:
         return set(self.minimum_to_decode(want_to_read, set(ordered)))
 
     # -- shared shard plumbing ----------------------------------------
-    def _stack_data(self, data: dict[int, jax.Array]) -> jax.Array:
-        """dict -> [..., k, N]; absent shards are zero (the shared
-        zero-buffer convention of the reference's encode_chunks).
-        All-numpy inputs stack on the host so small ops can take the
-        host GF path without a device round-trip; anything already on
-        device stacks there."""
+    def _shard_list_xp(self, data: dict[int, jax.Array]):
+        """(k shard arrays in index order, array namespace); absent
+        shards are zero (the shared zero-buffer convention of the
+        reference's encode_chunks). All-numpy inputs stay on the host
+        so small ops can take the host GF path without a device
+        round-trip; anything already on device fills with device
+        zeros."""
         sample = next(iter(data.values()))
         xp = (
             np
             if all(isinstance(v, np.ndarray) for v in data.values())
             else jnp
         )
-        shards = [
+        return [
             data.get(i, xp.zeros_like(sample)) for i in range(self.k)
-        ]
+        ], xp
+
+    def _shard_list(self, data: dict[int, jax.Array]) -> list:
+        return self._shard_list_xp(data)[0]
+
+    def _stack_data(self, data: dict[int, jax.Array]) -> jax.Array:
+        """dict -> [..., k, N] via _shard_list_xp's zero-fill rule."""
+        shards, xp = self._shard_list_xp(data)
         return xp.stack(shards, axis=-2)
 
     # -- byte-level wrappers (legacy-interface parity) ----------------

@@ -27,6 +27,7 @@ import numpy as np
 
 from ceph_tpu.gf import gf_matrix_to_bitmatrix
 from ceph_tpu.gf.bitmatrix import bitmatrix_invert, bitmatrix_matmul
+from ceph_tpu.ops import xor_schedule
 from ceph_tpu.ops.bitplane import xor_bytes
 
 from .base import ErasureCodeBase
@@ -66,15 +67,25 @@ def raid6_bitmatrix(k: int, w: int) -> bytes:
     if k > w:
         raise ValueError(f"k={k} must be <= w={w}")
     blocks: list[np.ndarray] = []
+    cells = [(r, c) for r in range(w) for c in range(w)]
     for j in range(k):
         base = _shift(w, j)
         placed = None
-        # Try the bare shift first, then single correction bits.
-        candidates = [None] + [(r, c) for r in range(w) for c in range(w)]
-        for cand in candidates:
+        # Iterative deepening over correction-bit count: the bare
+        # shift, then 1 bit, then 2 (prime w always succeeds at <= 1,
+        # so those matrices — corpus-frozen since v0 — are unchanged;
+        # even w, where S^d ^ S^e is never invertible, needs 2).
+        def candidates():
+            yield ()
+            for cell in cells:
+                yield (cell,)
+            for a in range(len(cells)):
+                for b in range(a + 1, len(cells)):
+                    yield (cells[a], cells[b])
+
+        for cand in candidates():
             x = base.copy()
-            if cand is not None:
-                r, c = cand
+            for r, c in cand:
                 x[r, c] ^= 1
             if not _invertible(x):
                 continue
@@ -97,6 +108,46 @@ def _is_prime(n: int) -> bool:
     if n < 2:
         return False
     return all(n % i for i in range(2, int(n**0.5) + 1))
+
+
+@functools.lru_cache(maxsize=None)
+def liberation_bitmatrix(k: int, w: int) -> bytes:
+    """The Liberation code construction (Plank, FAST'08) — the matrix
+    ``liberation_coding_bitmatrix`` builds for the reference's
+    liberation technique (ErasureCodeJerasure.cc:676; the vendored
+    jerasure sources are absent from the snapshot, so this is ported
+    from the paper's published definition, not the C file).
+
+    w prime, k <= w. P row: identity blocks. Q block X_i: ones at
+    (r, (r+i) mod w) for every r — the cyclic shift S^i — plus, for
+    i > 0, one extra bit at (y, (y+i-1) mod w) with y = i(w-1)/2 mod w.
+    Total Q density k*w + k - 1 ones: the minimal-density bound the
+    family is named for. MDS (every X_i and X_i ^ X_j invertible) is
+    re-verified exhaustively at construction time rather than trusted.
+    """
+    if not _is_prime(w):
+        raise ValueError(f"liberation requires prime w, got {w}")
+    if k > w:
+        raise ValueError(f"k={k} must be <= w={w}")
+    coding = np.zeros((2 * w, k * w), dtype=np.uint8)
+    blocks: list[np.ndarray] = []
+    for i in range(k):
+        coding[:w, i * w : (i + 1) * w] = np.eye(w, dtype=np.uint8)
+        x = np.zeros((w, w), dtype=np.uint8)
+        for r in range(w):
+            x[r, (r + i) % w] = 1
+        if i > 0:
+            y = (i * ((w - 1) // 2)) % w
+            x[y, (y + i - 1) % w] ^= 1
+        if not _invertible(x) or any(
+            not _invertible(x ^ b) for b in blocks
+        ):
+            raise ValueError(
+                f"liberation construction not MDS for k={k}, w={w}"
+            )
+        blocks.append(x)
+        coding[w:, i * w : (i + 1) * w] = x
+    return coding.tobytes()
 
 
 @functools.lru_cache(maxsize=None)
@@ -124,6 +175,34 @@ def blaum_roth_bitmatrix(k: int, w: int) -> bytes:
         coding[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)
         coding[w:, j * w : (j + 1) * w] = block
         block = bitmatrix_matmul(block, c)
+    return coding.tobytes()
+
+
+@functools.lru_cache(maxsize=None)
+def sparse_power_bitmatrix(k: int, w: int = 8) -> bytes:
+    """RAID-6 Q blocks = the k *sparsest* multiplication-by-g^e
+    bitmatrices over GF(2^8). Any distinct powers are pairwise MDS
+    (C^a ^ C^b = C^b (C^(a-b) ^ I), multiplication by g^(a-b) + 1
+    != 0), so density is a free choice — picking the sparsest k of
+    the 255 powers (ones counts 8, 11, 11, 14, 14, 17, 18, 18 for
+    k=8 -> 111 total vs ~128 for random powers) keeps the XOR
+    schedule short. Exponents are frozen by the deterministic
+    (ones, exponent) sort; the layout is corpus-pinned."""
+    from ceph_tpu.gf.tables import gf_pow, mul_bitmatrix
+
+    if w != 8:
+        raise ValueError("sparse_power_bitmatrix implemented for w=8")
+    if k > 2**w - 1:
+        raise ValueError(f"k={k} too large for w={w}")
+    dens = sorted(
+        (int(np.asarray(mul_bitmatrix(gf_pow(2, e))).sum()), e)
+        for e in range(2**w - 1)
+    )
+    chosen = sorted(e for _, e in dens[:k])
+    coding = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for j, e in enumerate(chosen):
+        coding[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)
+        coding[w:, j * w : (j + 1) * w] = mul_bitmatrix(gf_pow(2, e))
     return coding.tobytes()
 
 
@@ -175,6 +254,7 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         self.coding_bitmatrix: np.ndarray | None = None  # [m*w, k*w]
         self._tables = DecodeTableCache()       # device matrices
         self._host_tables = DecodeTableCache()  # packet 0/1 matrices
+        self._sched_tables = DecodeTableCache()  # XOR schedules
 
     def _set_bitmatrix(self, coding: np.ndarray) -> None:
         assert coding.shape == (self.m * self.w, self.k * self.w)
@@ -219,9 +299,12 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         tables: "tuple[np.ndarray, jax.Array] | None" = None,
     ) -> jax.Array:
         """Apply a packet-level 0/1 matrix to [..., S, N] chunks via
-        the shared engine: packetize, route (host / mesh / Pallas /
-        einsum), de-packetize. ``tables`` passes precomputed
-        bit-expanded forms (the encode path keeps them resident)."""
+        the shared engine: packetize, route (host / mesh / DCN /
+        XOR-schedule / Pallas / einsum), de-packetize. ``tables``
+        passes precomputed bit-expanded forms (the encode path keeps
+        them resident)."""
+        from ceph_tpu.utils import config
+
         packets = self._to_packets(stacked)
         if (
             not self._mesh_routable(packets)
@@ -232,10 +315,74 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
 
             _dispatch_counters().inc(f"host_{op}")
             out = gf_apply_bytes_host(mat01, np.asarray(packets))
+        elif (
+            config.get("ec_use_sched")
+            and not self._mesh_routable(packets)
+            and not self._dcn_routable(packets)
+            and xor_schedule.supported((1,) + packets.shape[-2:])
+            and (rows := self._schedule_rows(mat01)) is not None
+        ):
+            # schedule-native route: sparse packet matrices ARE XOR
+            # networks (jerasure_schedule_encode's insight); traffic
+            # tracks matrix density, not dimension. Dense matrices
+            # (inverted decode tables) fall through to the MXU engine.
+            _dispatch_counters().inc(f"sched_{op}")
+            out = xor_schedule.xor_schedule_apply(rows, packets)
         else:
             bm_np, bm_dev = tables or self._device_tables(mat01)
             out = self._dispatch_bitmatrix(bm_np, bm_dev, packets, op)
         return self._to_chunks(out)
+
+    def _try_sched_shards(
+        self, mat01: np.ndarray, shards: list, op: str
+    ):
+        """The no-copy hot path: route a packet-matrix apply through
+        the multi-operand schedule kernel, shard arrays in, shard
+        arrays out — no [.., n, chunk] stack, no packetize reshape
+        (both are real relayout copies on TPU; see
+        ops/xor_schedule.py). Returns the list of output shards, or
+        None when any precondition fails (dense matrix, off-TPU,
+        VMEM-oversized chunks, mesh/DCN installed, host-sized numpy
+        input — each of those keeps its existing route)."""
+        from ceph_tpu.utils import config
+
+        if not config.get("ec_use_sched") or not xor_schedule.on_tpu():
+            return None
+        rows = self._schedule_rows(mat01)
+        if rows is None:
+            return None
+        shape = shards[0].shape
+        if any(s.shape != shape for s in shards[1:]):
+            return None
+        if not xor_schedule.shards_supported(
+            len(shards), len(rows) // self.w, self.w, shape
+        ):
+            return None
+        if self._host_sized(*shards):
+            return None
+        # mesh/DCN routing operates on the stacked form and outranks
+        # single-chip paths; probe with the would-be stacked shape
+        probe = shape[:-1] + (len(shards) * self.w, shape[-1] // self.w)
+        if self._mesh_routable_shape(probe) or self._dcn_routable_shape(
+            probe, all(isinstance(s, np.ndarray) for s in shards)
+        ):
+            return None
+        _dispatch_counters().inc(f"sched_{op}")
+        return xor_schedule.xor_schedule_apply_shards(
+            rows, shards, self.w
+        )
+
+    def _schedule_rows(self, mat01: np.ndarray):
+        """Cached XOR schedule for a 0/1 packet matrix, or None when
+        the matrix is too dense for the schedule route to win."""
+        key = ("sched", mat01.tobytes(), mat01.shape)
+
+        def build():
+            rows = xor_schedule.schedule_rows(mat01)
+            ok = xor_schedule.profitable(rows, mat01.shape[1])
+            return rows if ok else None
+
+        return self._sched_tables.get(key, build)
 
     def _device_tables(self, mat01: np.ndarray):
         def build():
@@ -247,6 +394,12 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
+        shards = self._shard_list(data)
+        outs = self._try_sched_shards(
+            self.coding_bitmatrix, shards, "encode"
+        )
+        if outs is not None:
+            return {self.k + i: outs[i] for i in range(self.m)}
         parity = self._apply_packet_matrix(
             self.coding_bitmatrix,
             self._stack_data(data),
@@ -268,7 +421,14 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         dec01 = self._host_tables.get(
             key, lambda: self._build_decode_bitmatrix(present, want)
         )
-        stacked = self._stack([chunks[i] for i in present])
+        shard_list = [chunks[i] for i in present]
+        outs = self._try_sched_shards(dec01, shard_list, "decode")
+        if outs is not None:
+            result = {w: chunks[w] for w in want_to_read if w in chunks}
+            for idx, wshard in enumerate(want):
+                result[wshard] = outs[idx]
+            return result
+        stacked = self._stack(shard_list)
         out = self._apply_packet_matrix(dec01, stacked, "decode")
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, wshard in enumerate(want):
@@ -299,7 +459,14 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         w = self.w
         pcols = [c * w + t for c in cols for t in range(w)]
         mat01 = np.ascontiguousarray(self.coding_bitmatrix[:, pcols])
-        stacked = self._stack([delta[c] for c in cols])
+        shard_list = [delta[c] for c in cols]
+        outs = self._try_sched_shards(mat01, shard_list, "delta")
+        if outs is not None:
+            return {
+                pid: xor_bytes(p, outs[pid - self.k])
+                for pid, p in parity.items()
+            }
+        stacked = self._stack(shard_list)
         contrib = self._apply_packet_matrix(mat01, stacked, "delta")
         out = {}
         for pid, p in parity.items():

@@ -18,11 +18,25 @@ Technique parity with the reference:
 - blaum_roth        — RAID-6 bit-matrix, w+1 prime, k <= w
 - liber8tion        — RAID-6 bit-matrix, w = 8, k <= 8
 
-Profile keys: k, m, technique, w, packetsize. ``packetsize`` is
-validated, not swallowed: packet geometry on TPU is derived from chunk
-size (chunk = w packets), so an explicit nonzero packetsize — which
-would demand jerasure's packet-interleaved byte layout — is rejected
-with a clear error; 0/omitted means auto (the reference's default).
+Profile keys: k, m, technique, w, packetsize, construction.
+``packetsize`` is accepted for interop — the reference plugin writes
+its default (2048, ErasureCodeJerasure.h DEFAULT_PACKETSIZE) into
+every profile it normalizes, so reference-originated profiles carry
+the key — but the value is advisory here: packet geometry on TPU is
+derived from chunk size (chunk = w packets), which the class docstring
+documents. Negative values are still rejected.
+
+``construction`` selects the bit-matrix build for the packet
+techniques: omitted/default uses the reference-derived constructions
+(liberation = Plank FAST'08, blaum_roth = Blaum-Roth 1993, liber8tion
+= deterministic minimal-density search — the published liber8tion
+tables are in the absent vendored sources); ``v0`` pins the round-1
+constructions so corpus-v0 archives stay bit-reproducible forever.
+An UNVERSIONED profile means the reference construction: profiles
+that predate the key come from the reference ecosystem (which never
+writes one), so interop with those wins; framework archives from
+before the switch are exactly the corpus-v0 entries, which carry the
+explicit pin.
 """
 
 from __future__ import annotations
@@ -43,29 +57,27 @@ from .bitmatrix_codec import (
     _is_prime,
     blaum_roth_bitmatrix,
     gf2w_power_bitmatrix,
+    liberation_bitmatrix,
     raid6_bitmatrix,
+    sparse_power_bitmatrix,
 )
 from .interface import ErasureCodeProfile, Flag
 from .matrix_codec import MatrixErasureCodec
 from .registry import registry
 
 
-def _reject_packetsize(profile: ErasureCodeProfile) -> None:
-    """packetsize: VALIDATED, not silently swallowed — module-wide.
-    The TPU packet/byte geometry is derived from chunk size, so a
-    profile demanding jerasure's explicit packet-interleaved layout
-    cannot be honored bit-for-bit; reject it loudly. Omitting the key
-    (or 0 = "auto", the reference's default handling) keeps the
-    derived geometry."""
+def _accept_packetsize(profile: ErasureCodeProfile) -> int:
+    """packetsize: accepted, validated, advisory. The reference plugin
+    defaults it to 2048 and writes it into every normalized profile
+    (ErasureCodeJerasure.h DEFAULT_PACKETSIZE; .cc:649), so rejecting
+    a nonzero value broke reference-originated profiles (round-4
+    advisor finding). Geometry here is still chunk-derived — chunk =
+    w lane-aligned packets — so the value only survives as profile
+    metadata; 0/omitted means the same thing."""
     ps = to_int("packetsize", profile, 0)
     if ps < 0:
         raise ValueError(f"packetsize={ps} must be >= 0")
-    if ps > 0:
-        raise ValueError(
-            "explicit packetsize is not supported: packet geometry "
-            "is derived from chunk size; omit the key or pass 0 for "
-            "auto"
-        )
+    return ps
 
 
 class JerasureMatrixCodec(MatrixErasureCodec):
@@ -75,7 +87,7 @@ class JerasureMatrixCodec(MatrixErasureCodec):
 
     def init(self, profile: ErasureCodeProfile) -> None:
         self.profile = dict(profile)
-        _reject_packetsize(profile)
+        self.packetsize = _accept_packetsize(profile)
         self.k = to_int("k", profile, self.DEFAULT_K)
         self.m = to_int("m", profile, self.DEFAULT_M)
         self.w = to_int("w", profile, 8)
@@ -123,17 +135,33 @@ class CauchyGood(JerasureMatrixCodec):
 
 class LiberationBase(BitMatrixCodec):
     """Shared init for the RAID-6 bit-matrix techniques; subclasses
-    override the two varying hooks (_check_w, _build_matrix)."""
+    override the two varying hooks (_check_w, _build_matrix).
+
+    ``construction`` in the profile picks the matrix build: the
+    default is the reference-derived construction for each technique;
+    ``v0`` pins this framework's round-1 matrices (deterministic
+    minimal-density search for liberation, GF(2^8)-generator powers
+    for liber8tion) so the frozen corpus-v0 archives stay reproducible
+    — the cross-version guarantee corpus checking exists for."""
 
     technique = "liberation"
     DEFAULT_W = 7
+    CONSTRUCTIONS = ("default", "v0")
 
     def init(self, profile: ErasureCodeProfile) -> None:
         self.profile = dict(profile)
         self.k = to_int("k", profile, 2)
         self.m = to_int("m", profile, 2)
         self.w = to_int("w", profile, self.DEFAULT_W)
-        _reject_packetsize(profile)
+        self.construction = str(
+            profile.get("construction", "default")
+        )
+        self.packetsize = _accept_packetsize(profile)
+        if self.construction not in self.CONSTRUCTIONS:
+            raise ValueError(
+                f"unknown construction {self.construction!r}; choose "
+                f"from {self.CONSTRUCTIONS}"
+            )
         if self.k < 1:
             raise ValueError(f"k={self.k} must be >= 1")
         if self.m != 2:
@@ -151,7 +179,9 @@ class LiberationBase(BitMatrixCodec):
             raise ValueError(f"liberation requires prime w, got {self.w}")
 
     def _build_matrix(self) -> bytes:
-        return raid6_bitmatrix(self.k, self.w)
+        if self.construction == "v0":
+            return raid6_bitmatrix(self.k, self.w)
+        return liberation_bitmatrix(self.k, self.w)
 
 
 class Liberation(LiberationBase):
@@ -169,6 +199,8 @@ class BlaumRoth(LiberationBase):
             )
 
     def _build_matrix(self) -> bytes:
+        # one construction only: the ring-multiplication form IS the
+        # Blaum-Roth 1993 definition, and it has been stable since v0
         return blaum_roth_bitmatrix(self.k, self.w)
 
 
@@ -183,7 +215,18 @@ class Liber8tion(LiberationBase):
             raise ValueError("liber8tion requires k <= 8")
 
     def _build_matrix(self) -> bytes:
-        return gf2w_power_bitmatrix(self.k, 8)
+        if self.construction == "v0":
+            return gf2w_power_bitmatrix(self.k, 8)
+        # The published liber8tion tables live in the vendored
+        # liber8tion.c the snapshot lacks; these deterministic sparse
+        # constructions keep the same envelope (w=8, m=2, k<=8) and
+        # density class, frozen and corpus-pinned. k <= 4: minimal-
+        # density search (2 correction bits suffice); k >= 5 (where
+        # the search space runs dry): the k sparsest GF(2^8)
+        # generator-power blocks.
+        if self.k <= 4:
+            return raid6_bitmatrix(self.k, 8)
+        return sparse_power_bitmatrix(self.k, 8)
 
 
 TECHNIQUES = {

@@ -53,6 +53,11 @@ def _dispatch_counters():
         b.add_u64_counter(f"pallas_{op}", f"{op}s served by the Pallas kernel")
         b.add_u64_counter(f"einsum_{op}", f"{op}s served by the einsum engine")
         b.add_u64_counter(f"host_{op}", f"{op}s served by host GF tables")
+        b.add_u64_counter(
+            f"sched_{op}",
+            f"{op}s served by the schedule-native XOR kernel "
+            "(sparse packet bit-matrices)",
+        )
     b.add_u64_counter(
         "pallas_fallback",
         "dispatches where Pallas was enabled on TPU but the shape "
@@ -145,20 +150,25 @@ class BitplaneDispatchMixin:
         return mesh_dispatch.get_mesh()
 
     def _mesh_routable(self, stacked) -> bool:
+        return self._mesh_routable_shape(stacked.shape)
+
+    def _mesh_routable_shape(self, shape) -> bool:
         """True when a mesh is active AND this dispatch shape will
         actually ride it — the host small-op shortcut stays available
         for shapes that would only hit mesh_fallback (device launch
-        latency dwarfs the GF math there, same as without a mesh)."""
+        latency dwarfs the GF math there, same as without a mesh).
+        ``shape`` is the stacked [..., n_shards, chunk] form; the
+        sched-shards route probes with its would-be stacked shape."""
         mesh = self._active_mesh()
         if mesh is None:
             return False
         from ceph_tpu.parallel import dispatch as mesh_dispatch
 
-        c = stacked.shape[-2]
+        c = shape[-2]
         flat_shape = (
-            int(np.prod(stacked.shape[:-2], initial=1)),
+            int(np.prod(shape[:-2], initial=1)),
             c,
-            stacked.shape[-1],
+            shape[-1],
         )
         return mesh_dispatch.mesh_supported(mesh, (0, c * 8), flat_shape)
 
@@ -173,6 +183,11 @@ class BitplaneDispatchMixin:
         return jnp.stack(vals, axis=-2)
 
     def _dcn_routable(self, stacked) -> bool:
+        return self._dcn_routable_shape(
+            stacked.shape, isinstance(stacked, np.ndarray)
+        )
+
+    def _dcn_routable_shape(self, shape, host_staged: bool) -> bool:
         """True when a DCN cluster is installed AND this host-staged
         shape will ride it — like _mesh_routable, this must outrank
         the host small-op shortcut, or default-config dispatches
@@ -181,13 +196,13 @@ class BitplaneDispatchMixin:
         from ceph_tpu.parallel import dispatch as mesh_dispatch
 
         dcn = mesh_dispatch.get_dcn()
-        if dcn is None or not isinstance(stacked, np.ndarray):
+        if dcn is None or not host_staged:
             return False
-        c = stacked.shape[-2]
+        c = shape[-2]
         flat_shape = (
-            int(np.prod(stacked.shape[:-2], initial=1)),
+            int(np.prod(shape[:-2], initial=1)),
             c,
-            stacked.shape[-1],
+            shape[-1],
         )
         return dcn.supported((0, c * 8), flat_shape)
 

@@ -37,15 +37,35 @@ DEFAULT_SUITE: list[tuple[str, dict[str, str]]] = [
     ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "cauchy_orig", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2"}),
-    ("jerasure", {"technique": "liberation", "k": "4", "m": "2"}),
+    # construction=v0 pins the round-1 matrices: re-creating the v0
+    # tree must reproduce the ORIGINAL archive, not today's defaults
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2",
+                  "construction": "v0"}),
     ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2"}),
-    ("jerasure", {"technique": "liber8tion", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liber8tion", "k": "4", "m": "2",
+                  "construction": "v0"}),
     ("isa", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
     ("isa", {"technique": "cauchy", "k": "4", "m": "2"}),
     ("lrc", {"k": "4", "m": "2", "l": "3"}),
     ("shec", {"k": "4", "m": "3", "c": "2"}),
     ("clay", {"k": "4", "m": "2", "d": "5"}),
 ]
+
+# v1 (round 5): the packet bit-matrix techniques under their
+# reference-derived constructions (liberation = Plank FAST'08 port,
+# blaum_roth = Blaum-Roth 1993 ring form, liber8tion = frozen
+# minimal-density search) — the v0 entries for these pin
+# construction=v0, so both matrix generations stay covered forever.
+V1_SUITE: list[tuple[str, dict[str, str]]] = [
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liberation", "k": "6", "m": "2",
+                  "w": "7"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liber8tion", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liber8tion", "k": "8", "m": "2"}),
+]
+
+SUITES = {"v0": DEFAULT_SUITE, "v1": V1_SUITE}
 
 PAYLOAD_SIZE = 31 * 1024 + 17  # ragged on purpose: exercises padding
 
@@ -163,7 +183,14 @@ def main(argv: list[str] | None = None) -> int:
     honor_platform_env()
 
     if args.action == "create":
-        for plugin, profile in DEFAULT_SUITE:
+        version = os.path.basename(os.path.normpath(args.base))
+        suite = SUITES.get(version)
+        if suite is None:
+            p.error(
+                f"--base must end in a known corpus version "
+                f"({sorted(SUITES)}), got {version!r}"
+            )
+        for plugin, profile in suite:
             path = run_create(args.base, plugin, profile, args.size)
             print(f"created {path}")
         return 0

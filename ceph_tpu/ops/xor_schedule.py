@@ -1,0 +1,309 @@
+"""Schedule-native XOR engine for sparse packet bit-matrix codes.
+
+The reference executes liberation / blaum_roth / liber8tion (and the
+cauchy techniques) as XOR *schedules*: ``jerasure_smart_bitmatrix_to_
+schedule`` walks the 0/1 coding matrix and emits one XOR per set bit,
+so encode cost tracks matrix density, not dimension
+(jerasure/ErasureCodeJerasure.h:255-324, ``jerasure_schedule_encode``).
+Routing those codes through the generic bit-plane MXU engine pays the
+full [m*w*8, k*w*8] matrix stream with none of that sparsity — the r4
+bench measured 35-83 GB/s vs 296 for the flagship byte code.
+
+This module is the TPU form of the schedule: parity packet q is the
+XOR of the data packets its matrix row selects (~k+1 of k*w for the
+minimal-density families), executed as one Pallas VPU kernel blocked
+over (stripe, lane-tile). No MXU, no bit-plane unpack — traffic is
+(ones + m*w) packets per stripe against HBM, which on v5e measured
+553-621 GB/s data-in at the r4 bench geometry (experiments/
+exp_r5_sched.py), ~0.7x the pure-read roofline.
+
+Dense matrices (inverted decode matrices run ~50% ones) stay on the
+MXU engine — ``profitable`` gates the route by density.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+#: lane-tile granularity; multiples of 2048 keep uint8 blocks on the
+#: native (32, 128) tiling, and 8192 measured at/above every larger
+#: tile on v5e (grid-step overhead is already amortized there)
+LANE_TILE = 2048
+BEST_TILE = 8192
+
+#: density gate: the schedule's HBM traffic is (ones + rows) packets
+#: per ``cols`` packets of data in, so its rate is ~roofline/ratio.
+#: The minimal-density families encode at ratio 2.1-3.0; the
+#: single-chunk parity delta — the common small-write RMW shape —
+#: runs 4 + 1/w (the fixed m*w output rows charge against one
+#:  chunk's w columns), so the gate sits above that; inverted decode
+#: matrices (~50% ones) run 10+ and stay on the MXU engine.
+MAX_TRAFFIC_RATIO = 5.0
+
+
+def schedule_rows(mat01: np.ndarray) -> tuple[tuple[int, ...], ...]:
+    """Static XOR schedule: row q -> indices of the packets to XOR.
+
+    The ``jerasure_smart_bitmatrix_to_schedule`` analog, except the
+    "schedule" is consumed by a vector kernel instead of a C loop, so
+    there is no operation reordering to minimize — only selection.
+    """
+    m = np.asarray(mat01)
+    return tuple(
+        tuple(int(j) for j in np.flatnonzero(m[q])) for q in range(m.shape[0])
+    )
+
+
+def profitable(
+    sel_rows: tuple[tuple[int, ...], ...], cols: int
+) -> bool:
+    """True when the matrix is sparse enough that XOR traffic beats
+    the MXU stream (minimal-density families: ~k+1 ones/row)."""
+    if not sel_rows or cols <= 0:
+        return False
+    ones = sum(len(s) for s in sel_rows)
+    return (ones + len(sel_rows)) <= MAX_TRAFFIC_RATIO * cols
+
+
+def supported(shape: tuple[int, ...]) -> bool:
+    """[B, KW, P] with the packet axis lane-tileable."""
+    return len(shape) == 3 and shape[-1] % LANE_TILE == 0
+
+
+def _pick_tile(p: int) -> int:
+    if p % BEST_TILE == 0:
+        return BEST_TILE
+    t = BEST_TILE - LANE_TILE
+    while t > LANE_TILE and p % t:
+        t -= LANE_TILE
+    return t
+
+
+@functools.lru_cache(maxsize=256)
+def _sched_fn(
+    sel_rows: tuple[tuple[int, ...], ...],
+    kw: int,
+    lane_tile: int,
+    interpret: bool,
+):
+    """Jitted (cached per static schedule) pallas apply. Functions only
+    in this cache — never device arrays (the round-3/4 tracer-leak
+    lesson applies to arrays, not callables)."""
+    mw = len(sel_rows)
+
+    def kernel(d_ref, o_ref):
+        d = d_ref[:]  # [1, KW, T] uint8
+        for q, sel in enumerate(sel_rows):
+            if sel:
+                acc = d[:, sel[0], :]
+                for j in sel[1:]:
+                    acc = acc ^ d[:, j, :]
+            else:
+                acc = jnp.zeros_like(d[:, 0, :])
+            o_ref[:, q, :] = acc
+
+    @jax.jit
+    def apply(packets):
+        b, _, p = packets.shape
+        return pl.pallas_call(
+            kernel,
+            grid=(b, p // lane_tile),
+            in_specs=[
+                pl.BlockSpec((1, kw, lane_tile), lambda i, c: (i, 0, c))
+            ],
+            out_specs=pl.BlockSpec(
+                (1, mw, lane_tile), lambda i, c: (i, 0, c)
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, mw, p), jnp.uint8),
+            interpret=interpret,
+        )(packets)
+
+    return apply
+
+
+def _xla_apply(
+    sel_rows: tuple[tuple[int, ...], ...], packets: jax.Array
+) -> jax.Array:
+    """Off-TPU form: unrolled jnp XOR chains (XLA fuses the row
+    gathers and chains into one elementwise pass)."""
+    outs = []
+    zero = None
+    for sel in sel_rows:
+        if sel:
+            acc = packets[..., sel[0], :]
+            for j in sel[1:]:
+                acc = acc ^ packets[..., j, :]
+        else:
+            if zero is None:
+                zero = jnp.zeros_like(packets[..., 0, :])
+            acc = zero
+        outs.append(acc)
+    return jnp.stack(outs, axis=-2)
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------- shards form
+#: scoped VMEM is 16 MiB on v5e; Mosaic's own scratch for this kernel
+#: measured ~3.8 MiB (a 12.58 MB block set OOMs by 396 KiB, an
+#: 11.0 MB set compiles), so gate the whole-chunk form at 12 MB of
+#: block bytes and leave the rest as headroom
+VMEM_BUDGET = 12_000_000
+SUBLANE = 8
+
+
+def shards_supported(
+    n_in: int, n_out: int, w: int, shape: tuple[int, ...]
+) -> bool:
+    """Can the shards-form kernel serve [B, chunk] shard arrays?
+
+    Requirements: 2D after lead-flatten, packet size lane-aligned,
+    batch a sublane multiple (or small enough to be one block), and
+    (n_in + n_out) * sb * chunk within the VMEM budget.
+    """
+    if len(shape) < 1:
+        return False
+    chunk = shape[-1]
+    b = int(np.prod(shape[:-1], initial=1))
+    if chunk % w or (chunk // w) % 128:
+        return False
+    sb = SUBLANE if b % SUBLANE == 0 else b
+    return (n_in + n_out) * sb * chunk <= VMEM_BUDGET
+
+
+@functools.lru_cache(maxsize=256)
+def _sched_shards_fn(
+    sel_rows: tuple[tuple[int, ...], ...],
+    n_in: int,
+    w: int,
+    chunk: int,
+    sb: int,
+    interpret: bool,
+):
+    """Multi-operand whole-chunk kernel: k separate [B, chunk] shard
+    operands, m separate [B, chunk] parity results, packets addressed
+    as in-kernel lane slices. The single-operand form pays a real
+    relayout copy for the [B, k, chunk] stack and the packetize
+    reshape (TPU tiles the minor-most two dims, so those reshapes
+    move every byte); this form never materializes either — measured
+    407 vs ~100 GB/s data-in on the r4 bench geometry
+    (experiments/exp_r5_multiop.py)."""
+    p = chunk // w
+    n_out = len(sel_rows) // w
+
+    def kernel(*refs):
+        ins, outs = refs[:n_in], refs[n_in:]
+
+        def packet(j):
+            ci, pi = divmod(j, w)
+            return ins[ci][:, pi * p : (pi + 1) * p]
+
+        for q, sel in enumerate(sel_rows):
+            if sel:
+                acc = packet(sel[0])
+                for j in sel[1:]:
+                    acc = acc ^ packet(j)
+            else:
+                acc = jnp.zeros((refs[0].shape[0], p), jnp.uint8)
+            qc, qp = divmod(q, w)
+            outs[qc][:, qp * p : (qp + 1) * p] = acc
+
+    @jax.jit
+    def apply(*shards):
+        b = shards[0].shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(b // sb,),
+            in_specs=[
+                pl.BlockSpec((sb, chunk), lambda i: (i, 0))
+                for _ in range(n_in)
+            ],
+            out_specs=[
+                pl.BlockSpec((sb, chunk), lambda i: (i, 0))
+                for _ in range(n_out)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, chunk), jnp.uint8)
+                for _ in range(n_out)
+            ],
+            interpret=interpret,
+        )(*shards)
+
+    return apply
+
+
+def xor_schedule_apply_shards(
+    sel_rows: tuple[tuple[int, ...], ...],
+    shards: list,
+    w: int,
+    interpret: bool | None = None,
+) -> list:
+    """Shards-form schedule apply: ``shards`` are n_in arrays of
+    [..., chunk] (common shape); returns n_out = len(sel_rows)/w
+    arrays of the same shape, one per output shard. Row q of the
+    schedule indexes input packet (q//w, q%w) across the shard list.
+
+    On TPU this is the no-copy hot path; off-TPU it falls back to the
+    fused-XLA packetized form (CPU tests can force interpret=True for
+    bit-exact kernel coverage).
+    """
+    n_in = len(shards)
+    lead = shards[0].shape[:-1]
+    chunk = shards[0].shape[-1]
+    n_out = len(sel_rows) // w
+    if interpret is None:
+        if not on_tpu():
+            stacked = jnp.stack(
+                [jnp.asarray(s) for s in shards], axis=-2
+            )
+            pk = stacked.reshape(lead + (n_in * w, chunk // w))
+            out = _xla_apply(sel_rows, pk)
+            ch = out.reshape(lead + (n_out, chunk))
+            return [ch[..., j, :] for j in range(n_out)]
+        interpret = False
+    b = int(np.prod(lead, initial=1))
+    sb = SUBLANE if b % SUBLANE == 0 else b
+    fn = _sched_shards_fn(sel_rows, n_in, w, chunk, sb, interpret)
+    flat = [jnp.asarray(s).reshape(b, chunk) for s in shards]
+    outs = fn(*flat)
+    return [o.reshape(lead + (chunk,)) for o in outs]
+
+
+def xor_schedule_apply(
+    sel_rows: tuple[tuple[int, ...], ...],
+    packets: jax.Array,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Apply a static XOR schedule to [..., KW, P] packets.
+
+    Pallas kernel on TPU (or interpret=True for bit-exact CPU tests);
+    plain fused XLA off-TPU. numpy input is accepted and returns a
+    device array (callers on the host path use their own GF engine).
+    """
+    if interpret is None:
+        interpret = False
+        if not on_tpu():
+            return _xla_apply(sel_rows, jnp.asarray(packets))
+    lead = packets.shape[:-2]
+    kw, p = packets.shape[-2:]
+    if p % LANE_TILE:
+        # a non-tileable packet axis would silently drop lanes (the
+        # grid floors to zero/partial blocks); callers gate with
+        # supported(), so reaching here is a contract violation
+        raise ValueError(
+            f"packet axis {p} not a multiple of {LANE_TILE}; "
+            "check supported() before calling"
+        )
+    flat = jnp.asarray(packets).reshape((-1, kw, p))
+    out = _sched_fn(sel_rows, kw, _pick_tile(p), interpret)(flat)
+    return out.reshape(lead + out.shape[-2:])

@@ -1,0 +1,115 @@
+"""Round-5: where does the codec dispatch path lose bandwidth?
+
+Bare schedule kernel: ~550-620 GB/s at [32, 28, 32768]. Full
+encode_chunks through the same kernel: ~99. Variants peel the layers:
+
+  v0  bare kernel, pre-stacked pre-packetized input
+  v1  + input stack-of-slices (the _stack_data copy)
+  v2  + output depacketize/slice/restack (the bench's consumer shape)
+  v3  the real codec.encode_chunks (all of the above + dispatch logic)
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.ops import xor_schedule
+
+
+def loop_gbps(apply, data, nbytes, n1=100, n2=2100, reps=5):
+    @jax.jit
+    def loop(d0, iters):
+        def body(i, carry):
+            d, acc = carry
+            patch = (
+                jax.lax.dynamic_slice(d, (0, 0, 0), (1, 1, 128))
+                ^ jnp.uint8(i + 1)
+            )
+            d = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+            out = apply(d)
+            fold = jax.lax.dynamic_slice(out, (0, 0, 0), (1, 1, 128))[0, 0, 0]
+            return d, acc ^ fold
+
+        _, acc = jax.lax.fori_loop(0, iters, body, (d0, jnp.uint8(0)))
+        return acc
+
+    def timed(iters):
+        t0 = time.perf_counter()
+        np.asarray(loop(data, iters))
+        return time.perf_counter() - t0
+
+    for t in (n1, n2):
+        timed(t)
+    t1 = min(timed(n1) for _ in range(reps))
+    t2 = min(timed(n2) for _ in range(reps))
+    return nbytes / ((t2 - t1) / (n2 - n1)) / 1e9
+
+
+def main():
+    rng = np.random.default_rng(11)
+    codec = registry.factory(
+        "jerasure", {"technique": "liberation", "k": "4", "m": "2", "w": "7"}
+    )
+    k, w = 4, 7
+    chunk = 7 * 32768
+    p = chunk // w
+    kw = k * w
+    rows = xor_schedule.schedule_rows(codec.coding_bitmatrix)
+    nbytes = 32 * k * chunk
+
+    # v0: bare kernel
+    packets = jnp.asarray(rng.integers(0, 256, (32, kw, p), np.uint8))
+    g = loop_gbps(
+        lambda d: xor_schedule.xor_schedule_apply(rows, d), packets, nbytes
+    )
+    print(f"v0 bare kernel:            {g:.1f} GB/s", flush=True)
+
+    full = jnp.asarray(rng.integers(0, 256, (32, k, chunk), np.uint8))
+
+    # v1: + stack of slices -> packetize
+    def v1(d):
+        stacked = jnp.stack([d[:, i, :] for i in range(k)], axis=-2)
+        pk = stacked.reshape(32, kw, p)
+        return xor_schedule.xor_schedule_apply(rows, pk)
+
+    print(f"v1 + input stack:          {loop_gbps(v1, full, nbytes):.1f} GB/s",
+          flush=True)
+
+    # v1b: reshape WITHOUT the stack (d already [B, k, chunk])
+    def v1b(d):
+        pk = d.reshape(32, kw, p)
+        return xor_schedule.xor_schedule_apply(rows, pk)
+
+    print(f"v1b reshape only:          {loop_gbps(v1b, full, nbytes):.1f} GB/s",
+          flush=True)
+
+    # v2: + output depacketize/slice/restack
+    def v2(d):
+        stacked = jnp.stack([d[:, i, :] for i in range(k)], axis=-2)
+        pk = stacked.reshape(32, kw, p)
+        out = xor_schedule.xor_schedule_apply(rows, pk)
+        chunks = out.reshape(32, 2, chunk)
+        parts = {k + i: chunks[..., i, :] for i in range(2)}
+        return jnp.stack([parts[j] for j in sorted(parts)], axis=1)
+
+    print(f"v2 + output restack:       {loop_gbps(v2, full, nbytes):.1f} GB/s",
+          flush=True)
+
+    # v3: real codec path
+    def v3(d):
+        parity = codec.encode_chunks({i: d[:, i, :] for i in range(k)})
+        return jnp.stack([parity[j] for j in sorted(parity)], axis=1)
+
+    print(f"v3 codec.encode_chunks:    {loop_gbps(v3, full, nbytes):.1f} GB/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Round-5 experiment: where do the packet codes lose 200+ GB/s?
+
+Bench r4: liberation 82.6, blaum_roth 35.1, liber8tion 48.6 GB/s via
+the codec path, while the bare kernel at comparable contraction width
+(isa k=21, c=21 -> F=32) ran 369. Factors to separate:
+
+  A. shape smallness: the family bench uses 32 stripes x ~200 KiB
+     chunks (25-29 MB/iter) vs isa_k21m4's 344 MB/iter
+  B. the codec-path packetize/stack/restack XLA ops around the kernel
+  C. the packet matrix itself (r = m*w acc rows vs m)
+
+Run on the real chip: python experiments/exp_r5_packet.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.gf import gf_matrix_to_bitmatrix, isa_rs_matrix
+from ceph_tpu.ops import pallas_encode as pe
+
+
+def loop_gbps(apply, data, n1=5, n2=25, reps=3):
+    batch, k, n = data.shape
+
+    @jax.jit
+    def loop(d0, iters):
+        def body(i, carry):
+            d, acc = carry
+            patch = (
+                jax.lax.dynamic_slice(d, (0, 0, 0), (1, 1, 128))
+                ^ jnp.uint8(i + 1)
+            )
+            d = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+            out = apply(d)
+            fold = jax.lax.dynamic_slice(out, (0, 0, 0), (1, 1, 128))[0, 0, 0]
+            return d, acc ^ fold
+
+        _, acc = jax.lax.fori_loop(0, iters, body, (d0, jnp.uint8(0)))
+        return acc
+
+    def timed(iters):
+        t0 = time.perf_counter()
+        np.asarray(loop(data, iters))
+        return time.perf_counter() - t0
+
+    for t in (n1, n2):
+        timed(t)
+    diffs = []
+    for _ in range(reps):
+        d = (timed(n2) - timed(n1)) / (n2 - n1)
+        if d > 0:
+            diffs.append(d)
+    dt = float(np.median(diffs))
+    return batch * k * n / dt / 1e9
+
+
+def main():
+    rng = np.random.default_rng(11)
+    from ceph_tpu.codecs import registry
+
+    codec = registry.factory(
+        "jerasure", {"technique": "liberation", "k": "4", "m": "2", "w": "7"}
+    )
+    w = codec.w
+    kw, mw = 4 * w, 2 * w
+    lib_bmat = np.asarray(codec._encode_bmat_np)  # [mw*8, kw*8]
+
+    # C: bare kernel, packet matrix, pre-packetized input (no codec ops)
+    for stripes, lane in ((32, 32768), (128, 32768), (32, 65536), (64, 65536)):
+        data = jnp.asarray(
+            rng.integers(0, 256, (stripes, kw, lane), np.uint8)
+        )
+        g = loop_gbps(
+            lambda d: pe.gf_encode_bitplane_pallas(lib_bmat, d), data
+        )
+        print(f"bare liberation packet-matrix [{stripes},{kw},{lane}]: {g:.1f} GB/s", flush=True)
+
+    # B: synthetic byte code with the same c=28 contraction, r=2 vs r=14
+    gm = isa_rs_matrix(28, 2)
+    bm = gf_matrix_to_bitmatrix(np.asarray(gm)[28:, :])
+    data = jnp.asarray(rng.integers(0, 256, (32, 28, 32768), np.uint8))
+    g = loop_gbps(lambda d: pe.gf_encode_bitplane_pallas(bm, d), data)
+    print(f"bare byte c=28 r=2 [32,28,32768]: {g:.1f} GB/s", flush=True)
+
+    gm = isa_rs_matrix(28, 14)
+    bm = gf_matrix_to_bitmatrix(np.asarray(gm)[28:, :])
+    g = loop_gbps(lambda d: pe.gf_encode_bitplane_pallas(bm, d), data)
+    print(f"bare byte c=28 r=14 [32,28,32768]: {g:.1f} GB/s", flush=True)
+
+    # A: codec path exactly as bench _measure_code_families runs it
+    chunk = 7 * 32768
+    for stripes in (32, 128):
+        full = jnp.asarray(
+            rng.integers(0, 256, (stripes, 4, chunk), np.uint8)
+        )
+
+        def apply(d):
+            parity = codec.encode_chunks({i: d[:, i, :] for i in range(4)})
+            return jnp.stack([parity[j] for j in sorted(parity)], axis=1)
+
+        g = loop_gbps(apply, full)
+        print(f"codec path liberation [{stripes},4,{chunk}]: {g:.1f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

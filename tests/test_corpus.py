@@ -16,17 +16,28 @@ from ceph_tpu.corpus import (
     run_create,
 )
 
-BASE = os.path.join(os.path.dirname(__file__), "corpus", "v0")
+CORPUS_ROOT = os.path.join(os.path.dirname(__file__), "corpus")
 
-ENTRIES = sorted(iter_entries(BASE)) if os.path.isdir(BASE) else []
+ENTRIES = sorted(
+    e
+    for v in (os.listdir(CORPUS_ROOT) if os.path.isdir(CORPUS_ROOT) else ())
+    if os.path.isdir(os.path.join(CORPUS_ROOT, v))
+    for e in iter_entries(os.path.join(CORPUS_ROOT, v))
+)
 
 
 def test_corpus_exists():
-    assert len(ENTRIES) >= 10, "v0 corpus missing — run ceph_tpu.corpus create"
+    assert len(ENTRIES) >= 15, "corpus missing — run ceph_tpu.corpus create"
 
 
 @pytest.mark.parametrize(
-    "entry", ENTRIES, ids=[os.path.basename(e) for e in ENTRIES]
+    "entry",
+    ENTRIES,
+    ids=[
+        f"{os.path.basename(os.path.dirname(os.path.dirname(e)))}-"
+        f"{os.path.basename(e)}"
+        for e in ENTRIES
+    ],
 )
 def test_non_regression(entry):
     errors = run_check(entry)

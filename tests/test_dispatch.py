@@ -151,17 +151,64 @@ class TestBitMatrixFamilyOnEngine:
         }
         parity = lib_codec.encode_chunks(data)
         chunks = dict(data) | parity
-        del chunks[0], chunks[4]
-        out = lib_codec.decode_chunks({0, 4}, chunks)
-        deltas = {1: jnp.asarray(rng.integers(0, 256, (n,), np.uint8))}
+        # two DATA erasures: the decode matrix needs the inverted
+        # X-block compositions — dense, stays on the generic engine
+        # (a 1-data+1-parity pattern substitutes through the sparse P
+        # row and legitimately rides the schedule instead)
+        del chunks[0], chunks[1]
+        out = lib_codec.decode_chunks({0, 1}, chunks)
+        deltas = {
+            i: jnp.asarray(rng.integers(0, 256, (n,), np.uint8))
+            for i in (1, 2)
+        }
         lib_codec.apply_delta(deltas, {4: parity[4], 5: parity[5]})
         d = _delta(before, _snap())
-        assert d.get("einsum_encode", 0) >= 1
+        # encode: the sparse coding matrix rides the XOR-schedule route
+        assert d.get("sched_encode", 0) >= 1
+        assert d.get("einsum_encode", 0) == 0
         assert d.get("einsum_decode", 0) >= 1
-        assert d.get("einsum_delta", 0) >= 1
+        assert d.get("sched_decode", 0) == 0
+        assert d.get("sched_delta", 0) >= 1
         np.testing.assert_array_equal(
             np.asarray(out[0]), np.asarray(data[0])
         )
+
+    def test_sched_route_matches_engine(self, rng, lib_codec):
+        """Schedule-route parity must be bit-identical to the generic
+        engine's (the route is a perf choice, never a format one)."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.utils import config
+
+        n = 7 * 2048
+        data = {
+            i: jnp.asarray(rng.integers(0, 256, (n,), np.uint8))
+            for i in range(4)
+        }
+        sched = lib_codec.encode_chunks(dict(data))
+        with config.override(ec_use_sched=False):
+            engine = lib_codec.encode_chunks(dict(data))
+        for i in sched:
+            np.testing.assert_array_equal(
+                np.asarray(sched[i]), np.asarray(engine[i])
+            )
+
+    def test_sched_disabled_falls_back(self, rng, lib_codec):
+        import jax.numpy as jnp
+
+        from ceph_tpu.utils import config
+
+        n = 7 * 2048
+        data = {
+            i: jnp.asarray(rng.integers(0, 256, (n,), np.uint8))
+            for i in range(4)
+        }
+        before = _snap()
+        with config.override(ec_use_sched=False):
+            lib_codec.encode_chunks(data)
+        d = _delta(before, _snap())
+        assert d.get("sched_encode", 0) == 0
+        assert d.get("einsum_encode", 0) >= 1
 
     def test_host_routes_counted(self, rng, lib_codec):
         before = _snap()
