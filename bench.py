@@ -4,53 +4,45 @@ plus the full BASELINE.json scorecard.
 Prints ONE JSON line. Headline fields {"metric", "value", "unit",
 "vs_baseline"} report the encode throughput against the 25 GB/s/chip
 target (BASELINE.json north star); extra fields cover the rest of the
-BASELINE.md scorecard:
+BASELINE.md scorecard (see the keys in main()).
 
-  decode_gbps        on-chip reconstruct of 4 lost data shards from 8
-                     survivors (same bytes-in basis as encode)
-  vs_single_core     encode speedup over the native C single-core GF
-                     path (the ISA-L-role baseline, BASELINE.md target
-                     ">= 10x"); absent if the native lib is unavailable
-  hbm_gbps /         achieved HBM traffic (data-in + parity-out per
-  hbm_roofline_frac  encode) vs the ~819 GB/s v5e roofline
-  reconstruct_p50_ms / p99  single-chunk (64 KiB) reconstruct latency on
-                     the host small-op path (true per-op wall time — the
-                     low-latency path beside the bulk device path)
-  jerasure_k4m2_4k_gbps   BASELINE config 1: reed_sol_van k=4 m=2,
-                     4 KiB chunks, batched stripes
-  isa_k8m3_64k_gbps  BASELINE config 2: ISA-L RS k=8 m=3, 64 KiB stripe
-  cauchy_k10m4_1m_gbps  BASELINE config 3: cauchy_good k=10 m=4, 1 MiB
-                     object, 1024-stripe batch
-  clay_repair_gbps   BASELINE config 4: CLAY (8,4,d=11) MSR single-chunk
-                     repair, helper-bytes-read basis, host wall time
-  crc32c_gbps / crc32c_16k_gbps / crc32c_64k_gbps  BASELINE config 5:
-                     deep-scrub CRC32C over 4/16/64 KiB blocks
-  xxhash32_gbps / xxhash64_gbps  the remaining Checksummer algorithms
+Methodology (round 5 — the measurement itself is a deliverable;
+VERDICT r4 item 5):
 
-Methodology — honest under the axon device tunnel, where
-``block_until_ready`` resolves without waiting for remote execution
-and any real sync costs a ~0.1-0.5 s round trip:
-
-1. The iteration loop runs ON DEVICE (``lax.fori_loop``); each
-   iteration perturbs the input (so the encode is not loop-invariant)
-   and XOR-folds the parity into an accumulator the final readback
-   depends on — execution cannot be elided or overlapped away.
-2. Work is forced by reading back one byte of the accumulator
-   (``np.asarray``), not by ``block_until_ready``.
-3. The fixed tunnel round trip is cancelled by differencing two trip
-   counts: per_iter = (t(N2) - t(N1)) / (N2 - N1).
-4. A perturb-only loop measured the same way is subtracted so the
-   reported number is the kernel alone.
-5. Differenced estimates are noisy under tunnel-latency jitter — a
-   hiccup on the short trip makes a diff NEGATIVE. Each estimate is
-   the median of the positive diffs over several repeats (r1 took the
-   min, which once picked a glitch and printed 6.7e7 GB/s).
+1. **Feedback loops.** Each iteration's kernel OUTPUT patches the next
+   iteration's INPUT (a 128-byte slice), so iterations are serially
+   dependent *through the kernel*. Round-4's loop only perturbed the
+   input from the induction variable — with nothing consuming the
+   output inside the carry, the runtime overlapped/elided iterations:
+   a pure-copy kernel measured flat wall time from 100 to 8100
+   iterations. With feedback the same probe scales linearly and
+   reproduces the known bf16 matmul rate (~0.7 ms per 4096^3 step).
+2. **Working sets larger than VMEM.** v5e has 16 MiB of VMEM; any
+   input under that can be served without touching HBM after the
+   first pass, inflating "bandwidth" far beyond the roofline. All
+   throughput configs here stream >= 64 MB.
+3. **Diff-of-minima timing.** t(n1) and t(n2) are each timed `reps`
+   times; tunnel hiccups only ADD time, so min(t) is the clean
+   estimate of each; per-iter = (min t2 - min t1)/(n2 - n1). The
+   paired diffs additionally give a dispersion estimate reported as
+   `<key>_iqr` (inter-quartile range of per-iter GB/s across rep
+   pairs) for the headline metrics.
+4. **Self-calibrated roofline.** The HBM roofline is measured each
+   run with a pure-copy Pallas kernel over a 128 MB working set
+   (`hbm_copy_gbps`, read+write): the public 819 GB/s v5e figure
+   measures low; r5 observed ~1.1-1.2 TB/s. `hbm_roofline_frac` is
+   achieved encode traffic over the *measured* roofline.
+5. **Tunnel-health gate.** RTT is probed at start and end
+   (`tunnel_rtt_ms`, `tunnel_rtt_end_ms`); latency-class metrics
+   (smallop p99, host reconstruct) are annotated
+   `latency_degraded=true` when RTT > 5 ms — under a degraded tunnel
+   those numbers measure the tunnel, not the path. Throughput metrics
+   cancel RTT by construction.
 
 The reference tool's spirit is kept (big buffer, fixed iteration
 count, throughput = bytes/elapsed —
-src/test/erasure-code/ceph_erasure_code_benchmark.cc) with the timing
-adapted to remote-device reality. CLAY repair is host wall time (the
-small-op path), like the reference's per-call clock.
+src/test/erasure-code/ceph_erasure_code_benchmark.cc:185-192) with the
+timing adapted to remote-device reality.
 """
 
 from __future__ import annotations
@@ -63,11 +55,9 @@ import numpy as np
 K, M = 8, 4
 CHUNK = 1 << 20          # 1 MiB per shard
 BATCH = 8                # stripes per dispatch -> 64 MiB input per iter
-N1, N2 = 10, 110  # large span: the diff must dwarf tunnel RTT jitter
-REPS = 5
 TARGET_GBPS = 25.0
-V5E_HBM_GBPS = 819.0     # v5e-1 HBM bandwidth (public spec)
 LAT_CHUNK = 1 << 16      # 64 KiB single-chunk reconstruct latency probe
+RTT_HEALTHY_MS = 5.0
 
 
 def _timed(fn, *args) -> float:
@@ -76,69 +66,108 @@ def _timed(fn, *args) -> float:
     return time.perf_counter() - t0
 
 
-def _per_iter(fn, *args, n1=N1, n2=N2, reps=REPS) -> float:
-    """Median of positive differenced estimates (see module docstring)."""
-    diffs = []
-    for _ in range(reps):
-        d = (_timed(fn, *args, n2) - _timed(fn, *args, n1)) / (n2 - n1)
-        if d > 0:
-            diffs.append(d)
-    if not diffs:
-        raise RuntimeError("all differenced timings were negative")
-    return float(np.median(diffs))
+#: target kernel-time span between the two iteration counts: the
+#: differenced quantity must dwarf tunnel jitter (RTT swings of tens
+#: of ms under degradation), so spans auto-scale to ~this much
+#: on-device time regardless of per-iteration cost
+SPAN_TARGET_S = 0.45
+SPAN_MAX_ITERS = 40000
 
 
-def _device_loop_gbps(apply, data, n1=N1, n2=N2, reps=REPS):
-    """GB/s data-in for `apply` over [B, K, N] uint8 `data`.
+def _loop_stats(loop, data, n1=None, n2=None, reps=4):
+    """(per_iter_seconds, iqr_seconds) via diff-of-minima + paired
+    diffs. ``loop(data, iters)`` must be feedback-structured.
 
-    On-device loop where the per-iteration bookkeeping is NEGLIGIBLE
-    by construction: the input is perturbed only in a 128-byte slice
-    (the kernel still cannot be hoisted — its input changed) and only
-    a 128-byte slice of the output feeds the accumulator the readback
-    depends on (the kernel still runs fully — pallas output is
-    opaque to XLA, and the full HBM write happens). No perturb-loop
-    subtraction, which was fragile when kernel time ~ perturb time:
-    two noisy estimates subtracted once produced a 2 TB/s "decode".
+    Iteration counts auto-scale: a fixed n2=110 makes the differenced
+    span ~20 ms for fast kernels — below the degraded tunnel's jitter
+    floor, which round-4 bench entries (and an early r5 run that
+    printed a 960 GB/s "decode") show produces pure noise. A rough
+    warm-run estimate picks n2 so the span is ~SPAN_TARGET_S of real
+    kernel time; explicit n1/n2 skip the estimate."""
+    if n2 is None:
+        # iterative doubling with a MEASURED stop condition: a span
+        # estimate derived from two RTT-contaminated samples can be
+        # off by orders of magnitude (an early r5 run picked 40000
+        # iterations for a 200 us kernel and burned 80 s per metric);
+        # doubling stops when the wall-time delta itself clears the
+        # target, so the pick is right regardless of jitter. The
+        # probe ladder doubles as the warm-up (iters is a traced
+        # argument — one compile serves every count).
+        base = min(_timed(loop, data, 1) for _ in range(2))
+        n2 = 60
+        while n2 < SPAN_MAX_ITERS:
+            if _timed(loop, data, n2) - base >= SPAN_TARGET_S:
+                break
+            n2 *= 2
+        n2 = min(n2, SPAN_MAX_ITERS)
+        n1 = max(1, n2 // 10)
+    else:
+        for t in (n1, n2):
+            _timed(loop, data, t)  # warm/compile
+    t1s = [_timed(loop, data, n1) for _ in range(reps)]
+    t2s = [_timed(loop, data, n2) for _ in range(reps)]
+    per = (min(t2s) - min(t1s)) / (n2 - n1)
+    if per <= 0:
+        raise RuntimeError("non-positive differenced timing")
+    pairs = [
+        (b - a) / (n2 - n1) for a, b in zip(sorted(t1s), sorted(t2s))
+    ]
+    pairs = [p for p in pairs if p > 0]
+    if len(pairs) >= 3:
+        iqr = float(
+            np.percentile(pairs, 75) - np.percentile(pairs, 25)
+        )
+    else:
+        iqr = 0.0
+    return per, iqr
 
-    Off-TPU the apply is plain XLA (einsum), which a sliced consumer
-    WOULD dead-code down to 1/N of the work — there the accumulator
-    folds an xor-sum over the whole output instead (slower loop, but
-    off-TPU numbers are not the recorded ones)."""
+
+def _feedback_loop(apply, opaque: bool):
+    """Build the standard feedback loop over [B, C, N] uint8 data:
+    out -> 128-byte fold -> patches next input. Opaque (Pallas)
+    applies fold a slice (XLA cannot slice through the custom call);
+    plain-XLA applies fold the full output via sum, or XLA dead-codes
+    the unread majority of the work."""
     import jax
     import jax.numpy as jnp
-
-    from ceph_tpu.ops import pallas_encode as pe
-
-    batch, k, n = data.shape
-    opaque = pe.on_tpu()  # pallas path: XLA cannot slice through it
 
     @jax.jit
     def loop(d0, iters):
         def body(i, carry):
             d, acc = carry
-            patch = (
-                jax.lax.dynamic_slice(d, (0, 0, 0), (1, 1, 128))
-                ^ jnp.uint8(i + 1)
-            )
-            d = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
             out = apply(d)
             if opaque:
                 fold = jax.lax.dynamic_slice(
-                    out, (0, 0, 0), (1, 1, 128)
-                )[0, 0, 0]
+                    out, (0,) * (out.ndim - 1) + (0,),
+                    (1,) * (out.ndim - 1) + (128,),
+                )
+                patch = fold.reshape(1, 1, 128) ^ jnp.uint8(i + 1)
+                scalar = fold.reshape(-1)[0]
             else:
-                fold = jnp.sum(out, dtype=jnp.uint8)
-            return d, acc ^ fold
+                scalar = jnp.sum(out, dtype=jnp.uint8)
+                patch = jnp.full((1, 1, 128), scalar, jnp.uint8) ^ jnp.uint8(
+                    i + 1
+                )
+            d = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+            return d, acc ^ scalar
 
-        _, acc = jax.lax.fori_loop(
-            0, iters, body, (d0, jnp.uint8(0))
-        )
+        _, acc = jax.lax.fori_loop(0, iters, body, (d0, jnp.uint8(0)))
         return acc
 
-    for trips in (n1, n2):
-        _timed(loop, data, trips)
-    dt = _per_iter(loop, data, n1=n1, n2=n2, reps=reps)
-    return batch * k * n / dt / 1e9
+    return loop
+
+
+def _device_loop_gbps(apply, data, reps=4, opaque=None):
+    """(GB/s data-in, iqr GB/s) for `apply` over [B, C, N] uint8."""
+    from ceph_tpu.ops import pallas_encode as pe
+
+    batch, k, n = data.shape
+    if opaque is None:
+        opaque = pe.on_tpu()
+    loop = _feedback_loop(apply, opaque)
+    per, iqr = _loop_stats(loop, data, reps=reps)
+    gbps = batch * k * n / per / 1e9
+    return gbps, gbps - batch * k * n / (per + iqr) / 1e9
 
 
 def _kernel_apply(bmat_np):
@@ -154,7 +183,81 @@ def _kernel_apply(bmat_np):
     return lambda d: gf_encode_bitplane(dev, d)
 
 
-def _measure_device_path(result: dict) -> float:
+
+
+def _device_rand(shape, seed: int):
+    """Benchmark data generated ON DEVICE (jax PRNG + cast): a
+    degraded tunnel moves host arrays at only a few MB/s, so
+    uploading the 64-340 MB working sets dominated the whole run;
+    the kernels' cost is data-independent, so device PRNG bytes are
+    equivalent and free to produce."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(seed)
+    return jax.random.randint(
+        key, shape, 0, 256, dtype=jnp.int32
+    ).astype(jnp.uint8)
+
+
+def _measure_roofline(result: dict) -> float:
+    """Pure-copy (xor-1) Pallas kernel over 128 MB: the achievable
+    HBM read+write rate this run, the denominator for roofline
+    fractions. 2D [rows, lanes] layout — the sublane dimension stays
+    dense, so no tile padding confounds the number. Falls back to the
+    819 GB/s public spec off-TPU."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        from ceph_tpu.ops import pallas_encode as pe
+
+        if not pe.on_tpu():
+            return 819.0
+        # 117 MB in 3.7 MB blocks over few grid steps: big blocks keep
+        # per-step overhead out of the denominator (1 MB blocks over
+        # 128 steps measured 642 GB/s where this config reads ~1.1 TB/s)
+        rows, lanes, sb = 512, 229376, 16
+
+        def kernel(d_ref, o_ref):
+            o_ref[:] = d_ref[:] ^ jnp.uint8(1)
+
+        def copy(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(rows // sb,),
+                in_specs=[pl.BlockSpec((sb, lanes), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((sb, lanes), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, jnp.uint8),
+            )(x)
+
+        @jax.jit
+        def loop(d0, iters):
+            def body(i, carry):
+                d, acc = carry
+                out = copy(d)
+                fold = jax.lax.dynamic_slice(out, (0, 0), (1, 128))
+                d = jax.lax.dynamic_update_slice(
+                    d, fold ^ jnp.uint8(i + 1), (0, 0)
+                )
+                return d, acc ^ fold[0, 0]
+
+            _, acc = jax.lax.fori_loop(
+                0, iters, body, (d0, jnp.uint8(0))
+            )
+            return acc
+
+        data = _device_rand((rows, lanes), 0)
+        per, _ = _loop_stats(loop, data)
+        gbps = 2 * rows * lanes / per / 1e9  # read + write
+        result["hbm_copy_gbps"] = round(gbps, 1)
+        return gbps
+    except Exception:
+        return 819.0
+
+
+def _measure_device_path(result: dict, roofline: float) -> float:
     import jax.numpy as jnp
 
     from ceph_tpu.gf import (
@@ -167,35 +270,33 @@ def _measure_device_path(result: dict) -> float:
     enc_bmat_np = gf_matrix_to_bitmatrix(g[K:, :])
 
     # Decode config: lose data shards 4-7, survive on 0-3 + all parity
-    # (the exhaustive-erasures tool's worst standard case: a full-m
-    # erasure needing true matrix reconstruct, not passthrough).
+    # (a full-m erasure needing true matrix reconstruct).
     present = [0, 1, 2, 3, 8, 9, 10, 11]
     want = [4, 5, 6, 7]
-    dmat = decode_matrix(g, K, present)  # [k, len(present)]
+    dmat = decode_matrix(g, K, present)
     dec_rows = np.stack([dmat[w, :] for w in want])
     dec_bmat_np = gf_matrix_to_bitmatrix(dec_rows)
 
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(
-        rng.integers(0, 256, (BATCH, K, CHUNK)).astype(np.uint8)
-    )
+    data = _device_rand((BATCH, K, CHUNK), 0)
 
-    enc_gbps = _device_loop_gbps(_kernel_apply(enc_bmat_np), data)
-    dec_gbps = _device_loop_gbps(_kernel_apply(dec_bmat_np), data)
+    enc_gbps, enc_iqr = _device_loop_gbps(_kernel_apply(enc_bmat_np), data)
+    dec_gbps, dec_iqr = _device_loop_gbps(_kernel_apply(dec_bmat_np), data)
 
     enc_s = BATCH * K * CHUNK / enc_gbps / 1e9
     hbm_gbps = (BATCH * (K + M) * CHUNK) / enc_s / 1e9
 
+    result["value_iqr"] = round(enc_iqr, 2)
     result["decode_gbps"] = round(dec_gbps, 2)
+    result["decode_iqr"] = round(dec_iqr, 2)
     result["hbm_gbps"] = round(hbm_gbps, 1)
-    result["hbm_roofline_frac"] = round(hbm_gbps / V5E_HBM_GBPS, 3)
+    result["hbm_roofline_frac"] = round(hbm_gbps / roofline, 3)
     return enc_gbps
 
 
 def _measure_baseline_configs(result: dict) -> None:
-    """BASELINE configs 1-3: per-plugin encode throughput with the
-    config's exact geometry, same loop methodology (fewer reps — these
-    are secondary numbers)."""
+    """BASELINE configs 1-3 + the ISA envelope max: per-plugin encode
+    throughput with the config's exact geometry. Stripe counts sized
+    so every working set streams >= 64 MB (methodology note 2)."""
     import jax.numpy as jnp
 
     from ceph_tpu.gf import (
@@ -205,91 +306,112 @@ def _measure_baseline_configs(result: dict) -> None:
         vandermonde_rs_matrix,
     )
 
-    rng = np.random.default_rng(7)
     configs = [
         # (result key, generator matrix, k, m, chunk bytes, stripes)
         ("jerasure_k4m2_4k_gbps", vandermonde_rs_matrix(4, 2), 4, 2,
          4096, 4096),
         ("isa_k8m3_64k_gbps", isa_rs_matrix(8, 3), 8, 3, 8192, 1024),
+        # 100 KiB chunks as in BASELINE config 3, but 256 stripes
+        # (262 MB/iter): honest per-iteration timing makes the old
+        # 1 GiB set cost ~7 ms/iter of pure wall time for no extra
+        # signal — still 16x VMEM
         ("cauchy_k10m4_1m_gbps", cauchy_good_matrix(10, 4), 10, 4,
-         102400, 1024),
+         102400, 256),
         # the ISA-L documented envelope max (isa/README:23-24)
         ("isa_k21m4_gbps", isa_rs_matrix(21, 4), 21, 4, 65536, 256),
     ]
     for key, gmat, k, m, chunk, stripes in configs:
         try:
             bmat = gf_matrix_to_bitmatrix(np.asarray(gmat)[k:, :])
-            data = jnp.asarray(
-                rng.integers(0, 256, (stripes, k, chunk), np.uint8)
-            )
-            gbps = _device_loop_gbps(
-                _kernel_apply(bmat), data, n1=5, n2=45, reps=3
+            data = _device_rand((stripes, k, chunk), 7)
+            gbps, iqr = _device_loop_gbps(
+                _kernel_apply(bmat), data, reps=3
             )
             result[key] = round(gbps, 2)
+            result[key + "_iqr"] = round(iqr, 2)
         except Exception:
             pass  # scorecard entries are best-effort; headline must print
 
 
 def _measure_code_families(result: dict) -> None:
-    """Family-level device throughput for every remaining plugin class
-    (VERDICT r3 weak #3: the liberation family had no device perf
-    numbers at all). Measured through the REAL codec dispatch path —
-    registry factory, packetization, engine routing — not a bare
-    matmul, so these numbers include what a user actually gets from
-    ``encode_chunks``."""
+    """Family-level device throughput for the packet bit-matrix codes
+    and LRC/SHEC, through the REAL codec dispatch path — registry
+    factory, route selection, schedule/MXU kernels — not a bare
+    matmul. The packet families use the shards form: per-shard arrays
+    in, per-shard parity out (stacking the output back into one
+    tensor is a relayout copy the real pipeline never performs, so
+    the fold XORs 128-byte slices of each parity shard instead)."""
+    import jax
     import jax.numpy as jnp
 
     from ceph_tpu.codecs import registry
 
-    rng = np.random.default_rng(11)
     families = [
         # (result key, plugin, profile, chunk bytes, stripes)
         ("liberation_k4m2_gbps", "jerasure",
          {"technique": "liberation", "k": "4", "m": "2", "w": "7"},
-         7 * 32768, 32),
+         7 * 16384, 640),
         ("blaum_roth_k4m2_gbps", "jerasure",
          {"technique": "blaum_roth", "k": "4", "m": "2", "w": "6"},
-         6 * 32768, 32),
+         6 * 16384, 768),
         ("liber8tion_k4m2_gbps", "jerasure",
          {"technique": "liber8tion", "k": "4", "m": "2", "w": "8"},
-         8 * 32768, 32),
+         8 * 16384, 512),
         ("lrc_k4m2l3_gbps", "lrc",
-         {"k": "4", "m": "2", "l": "3"}, 65536, 128),
+         {"k": "4", "m": "2", "l": "3"}, 65536, 256),
         ("shec_k4m3c2_gbps", "shec",
-         {"k": "4", "m": "3", "c": "2"}, 65536, 128),
+         {"k": "4", "m": "3", "c": "2"}, 65536, 256),
     ]
     for key, plugin, profile, chunk, stripes in families:
         try:
             codec = registry.factory(plugin, dict(profile))
             k = codec.k
 
-            def apply(d, codec=codec, k=k):
+            def apply_dict(shards, codec=codec, k=k):
                 parity = codec.encode_chunks(
-                    {i: d[:, i, :] for i in range(k)}
+                    {i: shards[i] for i in range(k)}
                 )
-                return jnp.stack(
-                    [parity[j] for j in sorted(parity)], axis=1
-                )
+                return [parity[j] for j in sorted(parity)]
 
-            data = jnp.asarray(
-                rng.integers(0, 256, (stripes, k, chunk), np.uint8)
+            shards0 = tuple(
+                _device_rand((stripes, chunk), 11 + i)
+                for i in range(k)
             )
-            gbps = _device_loop_gbps(apply, data, n1=5, n2=25, reps=2)
-            result[key] = round(gbps, 2)
+
+            @jax.jit
+            def loop(arrs, iters, apply_dict=apply_dict):
+                def body(i, carry):
+                    arrs, acc = carry
+                    outs = apply_dict(arrs)
+                    fold = jax.lax.dynamic_slice(
+                        outs[0], (0, 0), (1, 128)
+                    )
+                    scalar = fold[0, 0]
+                    for o in outs[1:]:
+                        scalar = scalar ^ o[0, 0]
+                    first = jax.lax.dynamic_update_slice(
+                        arrs[0], fold ^ jnp.uint8(i + 1), (0, 0)
+                    )
+                    return (first,) + arrs[1:], acc ^ scalar
+
+                _, acc = jax.lax.fori_loop(
+                    0, iters, body, (arrs, jnp.uint8(0))
+                )
+                return acc
+
+            per, iqr = _loop_stats(loop, shards0, reps=3)
+            nbytes = stripes * k * chunk
+            result[key] = round(nbytes / per / 1e9, 2)
+            result[key + "_iqr"] = round(
+                nbytes / per / 1e9 - nbytes / (per + iqr) / 1e9, 2
+            )
         except Exception:
             pass  # scorecard entries are best-effort; headline must print
 
 
 def _measure_clay_repair(result: dict) -> None:
     """BASELINE config 4: CLAY (8,4,d=11) single-chunk repair, helper
-    bytes read per second of host wall time (the repair-bandwidth
-    story: (d*chunk)/(d-k+1) instead of k*chunk).
-
-    The repair body is trace-generic (round 3): with jax-array
-    helpers the whole plane schedule compiles to ONE device program,
-    so the standard on-device loop + trip-count differencing applies
-    (a slice of one helper is perturbed per iteration; the output
-    folds through a sum so XLA cannot dead-code the repair)."""
+    bytes read per second, device loop with feedback."""
     try:
         import jax
         import jax.numpy as jnp
@@ -304,64 +426,49 @@ def _measure_clay_repair(result: dict) -> None:
         sub = codec.get_sub_chunk_count()
         chunk = codec.get_chunk_size(k << 16)  # 64 KiB chunks
         sc = chunk // sub
-        stripes = 64
-        rng = np.random.default_rng(3)
-        data = {
-            i: rng.integers(0, 256, (stripes, chunk), np.uint8)
-            for i in range(k)
-        }
-        chunks = {
-            **data,
-            **{
-                i: np.asarray(v)
-                for i, v in codec.encode_chunks(data).items()
-            },
-        }
+        stripes = 256
         lost = k + 1  # a parity chunk: full helper-plane read path
 
         plan = codec.minimum_to_decode({lost}, set(range(n)) - {lost})
+        # helper bytes generated ON DEVICE: repair cost is
+        # data-independent, and correctness is covered by the test
+        # suite + dryrun — the bench only times the plane program
+        # (the old host-side encode of a 128 MB codeword + 45 MB
+        # upload cost minutes through a degraded tunnel)
         helper, read = {}, 0
-        for node, ranges in plan.items():
-            parts = [
-                chunks[node][..., idx * sc : (idx + cnt) * sc]
-                for idx, cnt in ranges
-            ]
-            read += sum(int(np.prod(p.shape)) for p in parts)
-            helper[node] = jnp.asarray(
-                np.concatenate(parts, axis=-1)
-            )
+        for hseed, (node, ranges) in enumerate(sorted(plan.items())):
+            nbytes = sum(cnt for _idx, cnt in ranges) * sc
+            read += stripes * nbytes
+            helper[node] = _device_rand((stripes, nbytes), 100 + hseed)
         keys = sorted(helper)
 
         @jax.jit
         def loop(arrs, iters):
             def body(i, carry):
                 arrs, acc = carry
-                first = arrs[0]
-                patch = (
-                    jax.lax.dynamic_slice(first, (0, 0), (1, 128))
-                    ^ jnp.uint8(i + 1)
-                )
-                arrs = (
-                    jax.lax.dynamic_update_slice(
-                        first, patch, (0, 0)
-                    ),
-                ) + arrs[1:]
                 out = codec.repair(
                     {lost}, dict(zip(keys, arrs))
                 )[lost]
-                return arrs, acc + jnp.sum(out, dtype=jnp.uint32)
+                fold = jax.lax.dynamic_slice(out, (0, 0), (1, 128))
+                first = jax.lax.dynamic_update_slice(
+                    arrs[0], fold ^ jnp.uint8(i + 1), (0, 0)
+                )
+                return (first,) + arrs[1:], acc + jnp.sum(
+                    fold, dtype=jnp.uint32
+                )
 
             _, acc = jax.lax.fori_loop(
-                0, iters, body,
-                (arrs, jnp.uint32(0)),
+                0, iters, body, (arrs, jnp.uint32(0))
             )
             return acc
 
         arrs = tuple(helper[kk] for kk in keys)
-        for trips in (5, 45):
-            _timed(loop, arrs, trips)
-        dt = _per_iter(loop, arrs, n1=5, n2=45, reps=3)
-        result["clay_repair_gbps"] = round(read / dt / 1e9, 2)
+        per, iqr = _loop_stats(loop, arrs, reps=3)
+        gbps = read / per / 1e9
+        result["clay_repair_gbps"] = round(gbps, 2)
+        result["clay_repair_iqr"] = round(
+            gbps - read / (per + iqr) / 1e9, 2
+        )
         # The hardware-independent MSR story: helper bytes read as a
         # fraction of the k*chunk a naive decode would read.
         result["clay_repair_read_frac"] = round(
@@ -373,12 +480,9 @@ def _measure_clay_repair(result: dict) -> None:
 
 def _measure_smallop_dispatch(result: dict) -> None:
     """Small-op (64 KiB = 8 x 8 KiB) encode throughput: the per-op
-    device path (one dispatch + readback per op — what a naive
-    pipeline pays per small write) vs the native-ring streaming
-    dispatcher aggregating 16 concurrent writers into batched
-    dispatches (pipeline/dispatcher.py). Reports aggregate GB/s for
-    both, the speedup, and client-observed p99 latency on the
-    streamed path."""
+    device path vs the native-ring streaming dispatcher aggregating 16
+    concurrent writers (pipeline/dispatcher.py). Latency-class metric:
+    annotated when the tunnel is degraded."""
     try:
         import threading
 
@@ -394,8 +498,6 @@ def _measure_smallop_dispatch(result: dict) -> None:
         k, chunk = K, 8192
         rng = np.random.default_rng(5)
 
-        # per-op path: sequential device dispatches (jax input forces
-        # the device route; readback per op, as a store write needs)
         ops = [
             jnp.asarray(rng.integers(0, 256, (k, chunk), np.uint8))
             for _ in range(16)
@@ -410,7 +512,6 @@ def _measure_smallop_dispatch(result: dict) -> None:
         perop_s = (time.perf_counter() - t0) / len(ops)
         perop_gbps = k * chunk / perop_s / 1e9
 
-        # streaming path: 16 writers x 24 ops each
         disp = StreamingDispatcher(codec, window_s=0.002)
         try:
             datas = rng.integers(
@@ -427,8 +528,7 @@ def _measure_smallop_dispatch(result: dict) -> None:
                     with lat_lock:
                         lat.append(dt)
 
-            # warm (compile the batched shape) before the clock
-            disp.encode_sync(datas[0])
+            disp.encode_sync(datas[0])  # warm the batched shape
             threads = [
                 threading.Thread(target=worker, args=(i,))
                 for i in range(16)
@@ -480,9 +580,8 @@ def _measure_single_core(result: dict, enc_gbps: float) -> None:
 
 def _measure_reconstruct_latency(result: dict) -> None:
     """p50/p99 single-chunk reconstruct on the host small-op path —
-    the low-latency lane beside the bulk device path (SURVEY.md §7
-    "small-chunk latency vs batch throughput"). True per-op wall
-    time: numpy in, numpy out, no device round trip."""
+    true per-op wall time: numpy in, numpy out, no device round
+    trip (so NOT tunnel-sensitive)."""
     from ceph_tpu.codecs.registry import registry
 
     codec = registry.factory("isa", {"k": str(K), "m": str(M)})
@@ -502,51 +601,47 @@ def _measure_reconstruct_latency(result: dict) -> None:
     result["reconstruct_p99_ms"] = round(float(np.percentile(lat_ms, 99)), 3)
 
 
-def _hash_loop_gbps(hash_fn, blocks, n1=N1, n2=N2, reps=3):
-    """Device-loop GB/s for a per-block hash kernel over [B, block].
-    Same slice-perturb discipline as _device_loop_gbps: bookkeeping
-    negligible, no fragile subtraction. Unlike the pallas EC kernel
-    (opaque to XLA), parts of the hash path are plain XLA ops — a
-    sliced consumer would let XLA dead-code most blocks — so the
-    accumulator folds an xor-sum over ALL per-block hashes (a 64 KiB
-    read, negligible next to the blocks themselves)."""
-    import jax
-    import jax.numpy as jnp
-
-    nblocks, block = blocks.shape
-
-    @jax.jit
-    def loop(b0, iters):
-        def body(i, carry):
-            b, acc = carry
-            patch = (
-                jax.lax.dynamic_slice(b, (0, 0), (1, 128))
-                ^ jnp.uint8(i + 1)
-            )
-            b = jax.lax.dynamic_update_slice(b, patch, (0, 0))
-            h = hash_fn(b)
-            return b, acc + jnp.sum(h, dtype=jnp.uint32)
-
-        _, acc = jax.lax.fori_loop(
-            0, iters, body, (b0, jnp.uint32(0))
-        )
-        return acc
-
-    for trips in (n1, n2):
-        _timed(loop, blocks, trips)
-    dt = _per_iter(loop, blocks, n1=n1, n2=n2, reps=reps)
-    return nblocks * block / dt / 1e9
-
-
 def _measure_checksums(result: dict) -> None:
-    """BASELINE config 5 (CRC32C over 4/16/64 KiB) + xxhash32/64."""
+    """BASELINE config 5 (CRC32C over 4/16/64 KiB) + xxhash32/64.
+    Feedback form: the per-block hash vector's first lanes patch the
+    next input; the accumulator folds the full hash vector (the hash
+    path is partly plain XLA — a sliced consumer would let XLA
+    dead-code most blocks)."""
     try:
+        import jax
         import jax.numpy as jnp
 
         from ceph_tpu.checksum.crc32c import crc32c_device
     except Exception:
         return
-    rng = np.random.default_rng(3)
+
+    def hash_loop_gbps(hash_fn, blocks, reps=3):
+        nblocks, block = blocks.shape
+
+        @jax.jit
+        def loop(b0, iters):
+            def body(i, carry):
+                b, acc = carry
+                h = hash_fn(b)  # [nblocks] uint32
+                s = jnp.sum(h, dtype=jnp.uint32)
+                patch = (
+                    jax.lax.dynamic_slice(h, (0,), (32,))
+                    .astype(jnp.uint8)
+                    .reshape(1, 32)
+                    ^ jnp.uint8(i + 1)
+                )
+                b = jax.lax.dynamic_update_slice(b, patch, (0, 0))
+                return b, acc + s
+
+            _, acc = jax.lax.fori_loop(
+                0, iters, body, (b0, jnp.uint32(0))
+            )
+            return acc
+
+        per, iqr = _loop_stats(loop, blocks, reps=reps)
+        g = nblocks * block / per / 1e9
+        return g, g - nblocks * block / (per + iqr) / 1e9
+
     size = 64 << 20
     for key, block in (
         ("crc32c_gbps", 4096),
@@ -554,55 +649,43 @@ def _measure_checksums(result: dict) -> None:
         ("crc32c_64k_gbps", 65536),
     ):
         try:
-            blocks = jnp.asarray(
-                rng.integers(0, 256, (size // block, block), np.uint8)
-            )
+            blocks = _device_rand((size // block, block), 3)
             reps = 5 if key == "crc32c_gbps" else 3
-            gbps = _hash_loop_gbps(
+            g, iqr = hash_loop_gbps(
                 lambda b: crc32c_device(b, 0xFFFFFFFF), blocks, reps=reps
             )
-            result[key] = round(gbps, 1)
+            result[key] = round(g, 1)
+            result[key + "_iqr"] = round(iqr, 1)
         except Exception:
             pass
     try:
         from ceph_tpu.checksum.xxhash import xxh32_device, xxh64_device
 
-        blocks = jnp.asarray(
-            rng.integers(0, 256, (size // 4096, 4096), np.uint8)
-        )
-        result["xxhash32_gbps"] = round(
-            _hash_loop_gbps(lambda b: xxh32_device(b), blocks), 1
-        )
+        blocks = _device_rand((size // 4096, 4096), 4)
+        g, iqr = hash_loop_gbps(lambda b: xxh32_device(b), blocks)
+        result["xxhash32_gbps"] = round(g, 1)
+        result["xxhash32_iqr"] = round(iqr, 1)
 
         def xx64(b):
-            import jax.numpy as jnp
-
             h = xxh64_device(b)
             return (h[0] ^ h[1]).astype(jnp.uint32) if isinstance(
                 h, tuple
             ) else h.astype(jnp.uint32)
 
-        result["xxhash64_gbps"] = round(
-            _hash_loop_gbps(xx64, blocks), 1
-        )
+        g, iqr = hash_loop_gbps(xx64, blocks)
+        result["xxhash64_gbps"] = round(g, 1)
+        result["xxhash64_iqr"] = round(iqr, 1)
     except Exception:
         pass
 
 
-def _measure_tunnel_rtt(result: dict) -> None:
-    """Record the device round-trip latency alongside the numbers:
-    the remote tunnel degrades by 100x+ for hours at a time (observed
-    ~0.5 ms vs ~110 ms), and latency-class entries (smallop p99,
-    per-op paths) are only meaningful against a healthy RTT. The
-    throughput entries cancel RTT by design (trip-count
-    differencing), so they stay comparable either way."""
+def _tunnel_rtt_ms() -> float | None:
+    """1-byte-readback device round trip: the tunnel-health probe."""
     try:
         import jax
         import jax.numpy as jnp
 
         x = jnp.asarray(np.zeros((8, 8192), np.uint8))
-        # 1-byte readback: a full-array fetch would fold transfer
-        # bandwidth into the number and misread a healthy tunnel
         f = jax.jit(lambda a: (a ^ 1)[0, :1])
         np.asarray(f(x))  # warm
         samples = []
@@ -610,22 +693,63 @@ def _measure_tunnel_rtt(result: dict) -> None:
             t0 = time.perf_counter()
             np.asarray(f(x))
             samples.append(time.perf_counter() - t0)
-        result["tunnel_rtt_ms"] = round(min(samples) * 1e3, 2)
+        return round(min(samples) * 1e3, 2)
     except Exception:
-        pass
+        return None
+
+
+def _phase(name):
+    """Progress + wall time per phase on stderr (stdout carries only
+    the one JSON line; the driver tails stderr when a run stalls)."""
+    import contextlib
+    import sys
+
+    @contextlib.contextmanager
+    def cm():
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            print(
+                f"[bench] {name}: {time.perf_counter() - t0:.1f}s",
+                file=sys.stderr, flush=True,
+            )
+
+    return cm()
 
 
 def main() -> None:
     result: dict = {}
-    _measure_tunnel_rtt(result)
-    enc_gbps = _measure_device_path(result)
-    _measure_baseline_configs(result)
-    _measure_code_families(result)
-    _measure_clay_repair(result)
-    _measure_smallop_dispatch(result)
-    _measure_single_core(result, enc_gbps)
-    _measure_reconstruct_latency(result)
-    _measure_checksums(result)
+    rtt = _tunnel_rtt_ms()
+    if rtt is not None:
+        result["tunnel_rtt_ms"] = rtt
+    with _phase("roofline"):
+        roofline = _measure_roofline(result)
+    with _phase("device_path"):
+        enc_gbps = _measure_device_path(result, roofline)
+    with _phase("baseline_configs"):
+        _measure_baseline_configs(result)
+    with _phase("code_families"):
+        _measure_code_families(result)
+    with _phase("clay_repair"):
+        _measure_clay_repair(result)
+    degraded = rtt is None or rtt > RTT_HEALTHY_MS
+    with _phase("smallop"):
+        _measure_smallop_dispatch(result)
+    with _phase("single_core"):
+        _measure_single_core(result, enc_gbps)
+    with _phase("reconstruct_latency"):
+        _measure_reconstruct_latency(result)
+    with _phase("checksums"):
+        _measure_checksums(result)
+    rtt_end = _tunnel_rtt_ms()
+    if rtt_end is not None:
+        result["tunnel_rtt_end_ms"] = rtt_end
+        degraded = degraded or rtt_end > RTT_HEALTHY_MS
+    if "smallop_p99_ms" in result or "reconstruct_p99_ms" in result:
+        # latency-class metrics measure the tunnel, not the path,
+        # when RTT is degraded — say so in-band
+        result["latency_degraded"] = bool(degraded)
     print(
         json.dumps(
             {
