@@ -40,7 +40,8 @@ from ceph_tpu.checksum.host import crc32c as _crc
 
 from . import framed_log
 from .allocator import ALLOCATORS, AllocError
-from .kvstore import KeyValueDB
+from .devicefs import DeviceFS
+from .kvstore import DeviceKVBackend, KeyValueDB
 from .transaction import Op, OpKind, Transaction
 
 #: KV prefixes (the column-family layout, BlueStore PREFIX_* style):
@@ -131,9 +132,38 @@ class BlockStore:
         self._dev = open(self.device_path, "r+b")
         self.device_size = os.path.getsize(self.device_path)
         self._objects: dict[str, _Onode] = {}
+        # -- metadata home: DeviceFS (the BlueFS analog) hosts the KV
+        # WAL/snapshot in reserved extents of THIS device, so the
+        # store is single-device self-contained (BlueFS.h:253). A
+        # store that already has host-file KV data keeps that legacy
+        # layout (its device blocks 0-1 may hold object data).
+        self._fs = None
+        legacy_kv = any(
+            os.path.exists(p)
+            for p in (
+                os.path.join(root, "kv.wal"),
+                os.path.join(root, "kv.snap"),
+                self.wal_path,
+                self.ckpt_path,
+            )
+        )
+        fs = DeviceFS(
+            self._dev_read, self._dev_write, self._dev_sync,
+            block_size,
+            lambda n: self.allocator.allocate(n),
+            lambda off, ln: self.allocator.release([(off, ln)]),
+        )
+        if DeviceFS.probe(self._dev_read, block_size):
+            fs.load()
+            self._fs = fs
+        elif not legacy_kv:
+            fs.format()
+            self._fs = fs
+        backend = DeviceKVBackend(self._fs) if self._fs else None
         # distinct "kv" namespace: the legacy format owned meta.wal
         self._kvdb = KeyValueDB(
-            root, name="kv", compact_every=checkpoint_every
+            root, name="kv", compact_every=checkpoint_every,
+            backend=backend,
         )
         self._load_metadata()
         self.allocator = ALLOCATORS[allocator](block_size)
@@ -210,12 +240,18 @@ class BlockStore:
         self._kvdb.submit_transaction(txn)
 
     def _rebuild_freelist(self) -> None:
-        """FreelistManager inversion: free = device minus live blobs."""
+        """FreelistManager inversion: free = device minus live blobs
+        minus the DeviceFS's own extents (superblocks + KV WAL/snap —
+        the BlueFS space-sharing arrangement)."""
         used: list[tuple[int, int]] = []
         for onode in self._objects.values():
             for blob in onode.blobs.values():
                 n_blocks = -(-blob.length // self.block_size)
                 used.append((blob.offset, n_blocks * self.block_size))
+        if self._fs is not None:
+            for off, ln in self._fs.reserved_extents():
+                n_blocks = -(-ln // self.block_size)
+                used.append((off, n_blocks * self.block_size))
         used.sort()
         pos = 0
         for off, ln in used:
@@ -233,6 +269,10 @@ class BlockStore:
     def _dev_read(self, offset: int, length: int) -> bytes:
         self._dev.seek(offset)
         return self._dev.read(length)
+
+    def _dev_sync(self) -> None:
+        self._dev.flush()
+        os.fsync(self._dev.fileno())
 
     def _csum(self, data: bytes) -> list[int]:
         out = []

@@ -89,6 +89,72 @@ class KVTransaction:
         return txn
 
 
+class FileKVBackend:
+    """Host-file durability tier: crc-framed WAL + snapshot file —
+    the standalone KeyValueDB's storage (a monitor store, say). The
+    BlockStore passes a DeviceFS-hosted backend instead, so ITS
+    metadata lives on the raw device (the BlueFS arrangement)."""
+
+    def __init__(self, root: str, name: str, sync: bool) -> None:
+        os.makedirs(root, exist_ok=True)
+        self.wal_path = os.path.join(root, f"{name}.wal")
+        self.snap_path = os.path.join(root, f"{name}.snap")
+        self.sync = sync
+
+    def snap_read(self) -> "bytes | None":
+        if not os.path.exists(self.snap_path):
+            return None
+        with open(self.snap_path, "rb") as f:
+            return f.read()
+
+    def wal_replay(self) -> list[bytes]:
+        return framed_log.replay(self.wal_path)
+
+    def wal_append(self, payload: bytes) -> None:
+        framed_log.append(self.wal_path, payload, sync=self.sync)
+
+    def snap_commit(self, snapshot: bytes) -> None:
+        """Snapshot durable, THEN truncate the WAL (rename-before-
+        truncate fsync ordering, as BlockStore._checkpoint)."""
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(snapshot)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        dirfd = os.open(
+            os.path.dirname(self.snap_path) or ".", os.O_RDONLY
+        )
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        with open(self.wal_path, "wb") as wal:
+            wal.flush()
+            os.fsync(wal.fileno())
+
+
+class DeviceKVBackend:
+    """DeviceFS-hosted durability tier: WAL frames and snapshots live
+    in reserved extents of the owning BlockStore's device (the BlueFS
+    role, os/bluestore/BlueFS.h:253)."""
+
+    def __init__(self, fs) -> None:
+        self.fs = fs
+
+    def snap_read(self) -> "bytes | None":
+        return self.fs.snap_read()
+
+    def wal_replay(self) -> list[bytes]:
+        return self.fs.wal_replay()
+
+    def wal_append(self, payload: bytes) -> None:
+        self.fs.wal_append(payload)
+
+    def snap_commit(self, snapshot: bytes) -> None:
+        self.fs.snap_commit(snapshot)
+
+
 class KeyValueDB:
     """Durable prefix-scoped KV store (RocksDBStore role)."""
 
@@ -98,10 +164,9 @@ class KeyValueDB:
         name: str = "kv",
         compact_every: int = 512,
         sync: bool = True,
+        backend=None,
     ) -> None:
-        os.makedirs(root, exist_ok=True)
-        self.wal_path = os.path.join(root, f"{name}.wal")
-        self.snap_path = os.path.join(root, f"{name}.snap")
+        self.backend = backend or FileKVBackend(root, name, sync)
         self.compact_every = compact_every
         self.sync = sync
         self._lock = threading.Lock()
@@ -111,14 +176,17 @@ class KeyValueDB:
 
     # -- recovery / compaction -----------------------------------------
     def _load(self) -> None:
-        if os.path.exists(self.snap_path):
-            with open(self.snap_path, "rb") as f:
-                self._apply(KVTransaction.decode(f.read()))
-        for payload in framed_log.replay(self.wal_path):
+        snap = self.backend.snap_read()
+        if snap is not None:
+            self._apply(KVTransaction.decode(snap))
+        for payload in self.backend.wal_replay():
             self._apply(KVTransaction.decode(payload))
             self._wal_batches += 1
-        if self._wal_batches >= self.compact_every:
-            self._compact()
+        # NO compaction here: the device backend's compaction
+        # allocates extents through the owning store's allocator,
+        # which is rebuilt only after this load returns (freelist
+        # needs the onodes). An over-threshold WAL compacts on the
+        # next submit instead.
 
     def _apply(self, txn: KVTransaction) -> None:
         for kind, prefix, key, value in txn.ops:
@@ -133,25 +201,12 @@ class KeyValueDB:
                     del self._table[pk]
 
     def _compact(self) -> None:
-        """Snapshot the table, then truncate the WAL (rename-before-
-        truncate fsync ordering, as BlockStore._checkpoint)."""
+        """Snapshot the table, then (logically) truncate the WAL —
+        the backend makes the pair atomic its own way."""
         snap = KVTransaction()
         for (prefix, key), value in sorted(self._table.items()):
             snap.set(prefix, key, value)
-        tmp = self.snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(snap.encode())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.snap_path)
-        dirfd = os.open(os.path.dirname(self.snap_path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
-        with open(self.wal_path, "wb") as wal:
-            wal.flush()
-            os.fsync(wal.fileno())
+        self.backend.snap_commit(snap.encode())
         self._wal_batches = 0
 
     # -- write side -----------------------------------------------------
@@ -164,7 +219,7 @@ class KeyValueDB:
         if not txn.ops:
             return
         with self._lock:
-            framed_log.append(self.wal_path, txn.encode(), sync=self.sync)
+            self.backend.wal_append(txn.encode())
             self._apply(txn)
             self._wal_batches += 1
             if self._wal_batches >= self.compact_every:
