@@ -105,9 +105,20 @@ class MonQuorumService:
             # leader's sync MUST resurrect (Paxos safety) — the proxy
             # consults this record to avoid re-running a command whose
             # incremental actually committed.
-            self._pending_blob[rank] = blob
-            self.paxos.commit(blob, leader)
-            self._pending_blob.pop(rank, None)
+            with self._lock:
+                self._pending_blob[rank] = blob
+            try:
+                self.paxos.commit(blob, leader)
+            finally:
+                # clear unless the rank died mid-commit — then the
+                # record must survive for the failover path's orphan
+                # check. Without this finally, a commit() that raised
+                # with the rank still alive left a stale blob a LATER
+                # failover could misread as that rank's orphan and
+                # skip a genuinely uncommitted command.
+                if rank not in self.dead:
+                    with self._lock:
+                        self._pending_blob.pop(rank, None)
             # durable BEFORE the Monitor applies and notifies — the
             # same ordering the single-mon path gets from
             # commit_fn=store.append. Without this, a crash between
@@ -233,7 +244,7 @@ class QuorumMonitor:
         "report_failure", "tick", "osd_erasure_code_profile_set",
         "osd_pool_create", "osd_pool_rm", "osd_pool_snap_create",
         "osd_pool_snap_rm", "pg_temp_set", "pg_temp_clear",
-        "trim_history",
+        "trim_history", "config_set", "config_rm",
     )
 
     def __init__(self, service: MonQuorumService) -> None:
@@ -297,7 +308,8 @@ class QuorumMonitor:
                     # leader's sync resurrects and commits. If that
                     # exact blob is now in the log, the command's
                     # effect landed — re-running it would double-apply.
-                    orphan = svc._pending_blob.pop(rank, None)
+                    with svc._lock:
+                        orphan = svc._pending_blob.pop(rank, None)
                     if orphan is not None:
                         new_leader = svc.leader()  # syncs + catches up
                         node = svc.paxos.nodes[svc._leader_rank]

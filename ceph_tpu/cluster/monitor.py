@@ -371,6 +371,57 @@ class Monitor:
             return self._propose(out=tuple(expired))
 
     # -- EC profiles & pools (OSDMonitor::parse_erasure_code_profile) ----
+    # -- central config db (ConfigMonitor analog) -----------------------
+    # mon/ConfigMonitor.h:15: a Paxos-replicated option store the
+    # monitors push to every daemon; daemons overlay it under their
+    # local file/env/runtime layers and observers fire on change.
+    _CONFIG_WHO_CLASSES = ("", "osd", "mon", "client")
+
+    def _check_config_who(self, who: str) -> None:
+        if who in self._CONFIG_WHO_CLASSES:
+            return
+        cls, _, ident = who.partition(".")
+        if cls in self._CONFIG_WHO_CLASSES[1:] and ident.isdigit():
+            return
+        raise CommandError(
+            f"bad config target {who!r}: use '' (global), a daemon "
+            f"class {self._CONFIG_WHO_CLASSES[1:]}, or class.id"
+        )
+
+    def config_set(self, name: str, value, who: str = "") -> OSDMap:
+        """``ceph config set <who> <name> <value>``: validate against
+        the option schema, commit through the quorum, push to every
+        subscribed daemon via the map channel."""
+        from ceph_tpu.utils.config import OPTIONS
+
+        self._check_config_who(who)
+        opt = next((o for o in OPTIONS if o.name == name), None)
+        if opt is None:
+            raise CommandError(f"unknown option {name!r}")
+        try:
+            opt.parse(value)  # type/range/enum check, value unused
+        except Exception as e:
+            raise CommandError(
+                f"invalid value for {name!r}: {e}"
+            ) from None
+        with self._command():
+            return self._propose(
+                new_config=((who, name, str(value)),)
+            )
+
+    def config_rm(self, name: str, who: str = "") -> OSDMap:
+        self._check_config_who(who)
+        with self._command():
+            return self._propose(new_config=((who, name, None),))
+
+    def config_db(self) -> dict:
+        """``ceph config dump``: the full replicated db."""
+        with self._lock:
+            return {
+                f"{who or 'global'}/{name}": val
+                for (who, name), val in sorted(self.osdmap.config.items())
+            }
+
     def osd_erasure_code_profile_set(
         self, name: str, profile: dict[str, str], force: bool = False
     ) -> OSDMap:

@@ -35,6 +35,8 @@ import threading
 from collections import OrderedDict
 
 from ceph_tpu.msg.messages import (
+    BackfillReserve,
+    BackfillReserveReply,
     ECSubRead,
     ECSubReadReply,
     ECSubWrite,
@@ -43,6 +45,10 @@ from ceph_tpu.msg.messages import (
     NotifyAck,
     OSDOp,
     OSDOpReply,
+    PGActivate,
+    PGActivateAck,
+    PGInfo,
+    PGInfoReply,
     PGList,
     PGListReply,
     Ping,
@@ -151,6 +157,13 @@ def shard_key(loc: str, shard: int) -> str:
 def split_shard_key(key: str) -> tuple[str, int]:
     loc, _, s = key.rpartition("#s")
     return loc, int(s)
+
+
+def first_live(acting: "list[int]") -> int:
+    """First non-hole member — THE primary-selection rule (matches
+    OSDMap.pg_primary; one definition, used everywhere the daemon
+    derives primacy from an acting list it already holds)."""
+    return next((o for o in acting if o != SHARD_NONE), SHARD_NONE)
 
 
 class _AnyShardStores(dict):
@@ -326,6 +339,15 @@ class _PG:
         self.backfilling = False    # pg_temp installed, data moving
         self.backfill_dirty: set[str] = set()  # written mid-backfill
         self.backfill_done = False  # moved; drop on next map change
+        #: peering gate (the PG active state): client ops eagain until
+        #: the serving primary has run the authoritative-log election
+        #: for this interval. Non-primaries are trivially peered —
+        #: they only serve sub-ops, which the (peered) primary drives.
+        self.peered = threading.Event()
+        self._peering = False
+        self._repeer = False
+        if first_live(acting) != daemon.osd_id:
+            self.peered.set()
         self.codec = registry.factory(spec.plugin, profile)
         chunk = daemon.chunk_size
         self.sinfo = StripeInfo(spec.k, spec.m, spec.k * chunk)
@@ -406,6 +428,24 @@ class OSDDaemon:
         self._worker: threading.Thread | None = None
         self._op_lock = threading.Lock()   # serializes client ops
         self._pg_lock = threading.Lock()   # guards _pgs + peer addrs
+        self._peer_lock = threading.Lock()  # guards _PG._peering flags
+        self._pgmeta_lock = threading.Lock()  # serializes les updates
+        #: mon config db entries this daemon has applied to the
+        #: process config's "mon" layer (name -> value)
+        self._mon_cfg_applied: dict[str, str] = {}
+        # -- backfill reservations (backfill_reservation.rst): the
+        # OSD's two AsyncReservers (common/AsyncReserver.h) bound
+        # concurrent backfills to osd_max_backfills, as the driving
+        # primary (local) and as a data-receiving target (remote)
+        from ceph_tpu.utils import config as _cfg
+        from ceph_tpu.utils.reserver import AsyncReserver
+
+        self.local_reserver = AsyncReserver(
+            lambda: _cfg.get("osd_max_backfills")
+        )
+        self.remote_reserver = AsyncReserver(
+            lambda: _cfg.get("osd_max_backfills")
+        )
         # Completed-mutation results by client reqid (pg-log reqid
         # dedup analog): a resend whose first attempt applied but whose
         # reply was lost replays the recorded outcome instead of
@@ -523,9 +563,42 @@ class OSDDaemon:
         self.messenger.shutdown()
 
     # -- map handling ---------------------------------------------------
+    def _apply_mon_config(self, osdmap: OSDMap) -> None:
+        """Overlay my slice of the mon-replicated config db into the
+        process config's "mon" layer (the MConfig push a daemon gets
+        on subscription; mon/ConfigMonitor.h:15). Scopes apply in
+        ascending specificity: global < "osd" < "osd.<id>". Observers
+        registered on the process config fire on any change. NOTE:
+        the process config is global, so in a many-daemons-per-
+        process test the last daemon to apply an id-scoped value
+        wins — class/global scopes are the meaningful ones there."""
+        from ceph_tpu.utils import config
+
+        eff: dict[str, str] = {}
+        for scope in ("", "osd", f"osd.{self.osd_id}"):
+            for (who, name), val in osdmap.config.items():
+                if who == scope:
+                    eff[name] = val
+        for name, val in eff.items():
+            if self._mon_cfg_applied.get(name) != val:
+                try:
+                    config.set(name, val, layer="mon")
+                except Exception as e:
+                    self.log.error(
+                        "mon config", name, "rejected:",
+                        type(e).__name__, str(e),
+                    )
+        for name in set(self._mon_cfg_applied) - set(eff):
+            try:
+                config.rm(name, layer="mon")
+            except Exception:
+                pass
+        self._mon_cfg_applied = eff
+
     def _on_map(self, osdmap: OSDMap) -> None:
         if self._stopped:
             return
+        self._apply_mon_config(osdmap)
         to_recover: list[tuple[_PG, list[int]]] = []
         to_release: list[tuple[_PG, list[int]]] = []
         with self._pg_lock:
@@ -575,10 +648,7 @@ class OSDDaemon:
                     # backfills. The pg_temp request commits a map
                     # change (recursive _on_map), so it runs after
                     # this lock is released.
-                    primary = next(
-                        (o for o in pg.acting if o != SHARD_NONE),
-                        SHARD_NONE,
-                    )
+                    primary = first_live(pg.acting)
                     if (
                         primary == self.osd_id
                         and (pool, pgid) not in osdmap.pg_temp
@@ -609,6 +679,14 @@ class OSDDaemon:
                 pg.backend.acting[:] = new_acting
                 pg.backend.recovering.update(healed)
                 pg.backend.recovering.difference_update(downed)
+                # interval change: whoever serves as primary now must
+                # re-run the authoritative-log election before serving
+                # this interval (and re-activate les). Non-primaries
+                # open their gate — the primary's peering judges them.
+                if first_live(new_acting) == self.osd_id:
+                    self._kick_peering(pg)
+                else:
+                    pg.peered.set()
                 if downed:
                     to_release.append((pg, downed))
                 if healed:
@@ -641,6 +719,8 @@ class OSDDaemon:
         # pg_temp mapping drives its backfill (covers temps installed
         # by OTHER daemons and primaries without a PG instance)
         self._adopt_pg_temps()
+        # eager interval peering for PGs with no live instance
+        self._peer_new_intervals()
 
     def _maybe_gc_pools(self) -> None:
         if self._doomed_pool_ids and self._gc_clean_streak < 2:
@@ -672,6 +752,16 @@ class OSDDaemon:
             batch.clear()
 
         for key in self.store.list_objects():
+            if key.startswith("pgmeta\x02"):
+                try:
+                    meta_pool = int(key.split("\x02")[1])
+                except (IndexError, ValueError):
+                    continue
+                if meta_pool in doomed:
+                    batch.append(key)
+                    if len(batch) >= 64:
+                        flush()
+                continue
             try:
                 loc, _si = split_shard_key(key)
                 pool_id, _oid = split_loc(loc)
@@ -692,8 +782,7 @@ class OSDDaemon:
             if pool not in osdmap.pools:
                 continue
             acting = osdmap.pg_to_up_acting(pool, pgid)
-            primary = next((o for o in acting if o != SHARD_NONE), SHARD_NONE)
-            if primary != self.osd_id:
+            if first_live(acting) != self.osd_id:
                 continue
             pg = self._get_pg(pool, pgid)
             self._start_backfill(pool, pgid, pg)
@@ -709,6 +798,11 @@ class OSDDaemon:
         vouch'). On failure the position reverts to a hole; the next
         map change retries."""
         try:
+            # the interval election first: catch-up judges the
+            # returning member against authoritative state, which is
+            # only established once the primary has peered
+            if not pg.peered.wait(timeout=60):
+                raise RuntimeError("peering never completed")
             # Pristine member stamps, captured before any replay or
             # refresh can overwrite them (see _member_listing).
             member_listing = self._member_listing(pg, shard)
@@ -827,6 +921,12 @@ class OSDDaemon:
                 acting = self.osdmap.pg_to_up_acting(pool, pgid)
                 pg = _PG(self, pool, pgid, raw, acting)
                 self._pgs[(pool, pgid)] = pg
+                if not pg.peered.is_set():
+                    # fresh instance with me as serving primary: the
+                    # interval must be peered before ops are served —
+                    # a restarted ex-primary's own store is not
+                    # authority (PeeringState.cc:1565 find_best_info)
+                    self._kick_peering(pg)
             return pg
 
     # -- object-info recovery (new-primary takeover) --------------------
@@ -978,6 +1078,328 @@ class OSDDaemon:
                 rollback.add(loc)
         return rollback, delete
 
+    # -- peering: authoritative-log election ---------------------------
+    # The find_best_info / choose_acting analog
+    # (osd/PeeringState.cc:1565, :2413): on taking the primary role
+    # for a changed interval, gather (last_epoch_started, last_update)
+    # from every up member, elect the authoritative log, rewind SELF
+    # against the winner when self is not it, and only then activate
+    # the interval (les := epoch, pushed durably to members). A
+    # returning ex-primary is thereby corrected at ADMISSION time —
+    # its divergent writes carry the old interval's les/epoch, so it
+    # loses the election to any member that served the newer interval.
+
+    def _pgmeta_key(self, pool_id: int, pgid: int) -> str:
+        # deliberately not shard_key-parseable: object scans skip it
+        return f"pgmeta\x02{pool_id}\x02{pgid}"
+
+    def _pgmeta_read(self, pool_id: int, pgid: int) -> int:
+        """Stored last_epoch_started, 0 when never activated."""
+        try:
+            return int(
+                self.store.getattr(self._pgmeta_key(pool_id, pgid), "les")
+            )
+        except (FileNotFoundError, KeyError, ValueError):
+            return 0
+
+    def _pgmeta_acting(self, pool_id: int, pgid: int) -> "list | None":
+        """The acting set I last activated this PG with (primaries
+        only), or None — the interval-change detector for PGs with no
+        live instance."""
+        try:
+            raw = self.store.getattr(
+                self._pgmeta_key(pool_id, pgid), "acting"
+            )
+            return [int(x) for x in raw.decode().split(",") if x != ""]
+        except (FileNotFoundError, KeyError, ValueError):
+            return None
+
+    def _pgmeta_write_les(
+        self, pool_id: int, pgid: int, epoch: int,
+        acting: "list | None" = None,
+    ) -> None:
+        # one lock for the read-check-write: a local activation
+        # (peering thread) and a remote PGActivate (messenger thread)
+        # interleaving here could write epochs out of order and
+        # REGRESS the ledger — which a later election would read as a
+        # stale interval and rank the member down
+        with self._pgmeta_lock:
+            key = self._pgmeta_key(pool_id, pgid)
+            les = self._pgmeta_read(pool_id, pgid)
+            if epoch <= les:
+                return  # activation epochs are monotone
+            txn = Transaction().touch(key).setattr(
+                key, "les", str(epoch).encode()
+            )
+            if acting is not None:
+                txn.setattr(
+                    key, "acting",
+                    ",".join(str(o) for o in acting).encode(),
+                )
+            self.store.queue_transactions(txn)
+
+    def _peer_new_intervals(self) -> None:
+        """Eager interval peering (the reference instantiates PGs on
+        every member and peers each interval change; PGs here are
+        otherwise lazy): after a map change, every PG I now serve as
+        primary whose acting set differs from the one I last
+        ACTIVATED gets instantiated and peered. Without this, an
+        interval with no client IO would leave no durable les trace —
+        and a returning ex-primary could then win the election with
+        its divergent (higher-tid) stamps."""
+        osdmap = self.osdmap
+        for pool, spec in osdmap.pools.items():
+            for pgid in range(spec.pg_num):
+                if (pool, pgid) in osdmap.pg_temp:
+                    continue  # backfill owns pg_temp intervals
+                acting = osdmap.pg_to_up_acting(pool, pgid)
+                if first_live(acting) != self.osd_id:
+                    continue
+                if self._pgmeta_acting(spec.pool_id, pgid) == acting:
+                    continue  # interval unchanged since my activation
+                self._kick_peering(self._get_pg(pool, pgid))
+
+    def _own_pg_info(
+        self, pool_id: int, pg_num: int, pgid: int
+    ) -> tuple[int, tuple[int, int]]:
+        """My pg_info_t analog, from durable state only: les from the
+        pgmeta ledger, last_update = max committed OI eversion over
+        the shard copies AT MY CURRENT ACTING POSITION (divergent
+        local applies can inflate the tid but never the les — only
+        post-peering activation writes that).
+
+        The si scoping matters (round-5 chaos seed 7702): stale keys
+        at OTHER positions — old-layout leftovers the divergence scan
+        deliberately leaves to backfill/GC — must not inflate the
+        vote, or a rewound member's lingering tampered leftovers
+        out-rank clean logs at les ties."""
+        my_pos = None
+        for pool, spec in self.osdmap.pools.items():
+            if spec.pool_id == pool_id:
+                acting = self.osdmap.pg_to_up_acting(pool, pgid)
+                if self.osd_id in acting:
+                    my_pos = acting.index(self.osd_id)
+                break
+        lu = (0, 0)
+        for loc, si in self._scan_pg_keys(pool_id, pg_num, pgid):
+            if my_pos is not None and si != my_pos:
+                continue
+            try:
+                _size, ev = parse_oi(
+                    self.store.getattr(shard_key(loc, si), OI_KEY)
+                )
+            except (FileNotFoundError, KeyError, ValueError):
+                continue
+            if tuple(ev) > lu:
+                lu = tuple(ev)
+        return self._pgmeta_read(pool_id, pgid), lu
+
+    def _handle_pg_info(self, conn: Connection, msg: PGInfo) -> None:
+        les, lu = self._own_pg_info(msg.pool_id, msg.pg_num, msg.pgid)
+        conn.send(PGInfoReply(msg.tid, msg.shard, les, lu[0], lu[1]))
+
+    def _handle_pg_activate(self, conn: Connection, msg: PGActivate) -> None:
+        self._pgmeta_write_les(msg.pool_id, msg.pgid, msg.epoch)
+        conn.send(PGActivateAck(msg.tid, msg.shard))
+
+    def _kick_peering(self, pg: _PG) -> None:
+        """Clear the peered gate and run the election on its own
+        thread (peering does network RPC + possibly O(PG) recovery;
+        callers hold locks). A kick landing while a run is already in
+        flight closes the gate and flags a RE-RUN: the in-flight
+        election saw the OLD interval, and letting it open the gate
+        for the new one would serve exactly the unpeered window this
+        machinery exists to prevent (round-5 review finding)."""
+        with self._peer_lock:
+            pg.peered.clear()
+            if pg._peering:
+                pg._repeer = True
+                return
+            pg._peering = True
+        threading.Thread(
+            target=self._peer_pg, args=(pg,), daemon=True
+        ).start()
+
+    def _peer_pg(self, pg: _PG) -> None:
+        """Election loop: re-runs while interval changes arrive
+        mid-election; the gate opens only when a full election has
+        seen the latest interval."""
+        while True:
+            done = self._peer_pg_once(pg)
+            with self._peer_lock:
+                if pg._repeer:
+                    pg._repeer = False
+                    continue  # a newer interval arrived mid-election
+                pg._peering = False
+                if done:
+                    pg.peered.set()
+                return
+
+    def _peer_pg_once(self, pg: _PG) -> bool:
+        """One election + self-rewind + activation pass; True on
+        success. On failure the gate stays closed (ops eagain; the
+        tick and the next map change retry) — serving unpeered is
+        the one thing this path exists to prevent."""
+        try:
+            spec = self.osdmap.pools[pg.pool]
+            # the interval this election is FOR, captured once: a
+            # newer map arriving mid-election invalidates every
+            # judgment made here. Without this guard (round-5 chaos
+            # seed 7702), an election kicked for epoch E ran with
+            # epoch E+1's membership half-applied, a reviving
+            # divergent member's racing activation tied the les
+            # ledger, its inflated tids won the tie, and a GOOD
+            # member rewound itself from the tampered store.
+            epoch0 = self.osdmap.epoch
+            acting0 = list(pg.acting)
+            if first_live(acting0) != self.osd_id:
+                # a kick from an older interval can fire after a newer
+                # map demoted this daemon — only the CURRENT primary
+                # may elect/rewind/activate (choose_acting runs on the
+                # primary, PeeringState.cc:2413)
+                return False
+            try:
+                my_pos = acting0.index(self.osd_id)
+            except ValueError:
+                return False  # no longer a member; a map re-kicks
+            infos: dict[int, tuple[int, tuple[int, int]]] = {}
+            for idx, osd in enumerate(acting0):
+                if osd == SHARD_NONE:
+                    continue
+                if idx in pg.backend.recovering and osd != self.osd_id:
+                    # a member mid-catch-up is mid-JUDGMENT: its OI
+                    # stamps may still carry divergent tids the
+                    # rollback has not rewritten. Counting it at a
+                    # les tie elected a tampered store as authority
+                    # (round-5 chaos seed 7702); it votes again once
+                    # admitted (clean by construction).
+                    continue
+                if osd == self.osd_id:
+                    infos[osd] = self._own_pg_info(
+                        spec.pool_id, spec.pg_num, pg.pgid
+                    )
+                    continue
+                try:
+                    infos[osd] = self.peers.get_pg_info(
+                        osd, spec.pool_id, spec.pg_num, pg.pgid
+                    )
+                except Exception:
+                    continue  # down members don't vote
+            # max by (les, last_update); ties prefer self (authority
+            # continuity), then lowest osd id — deterministic
+            best = max(
+                infos,
+                key=lambda o: (infos[o], o == self.osd_id, -o),
+            )
+            if best != self.osd_id and infos[best] > infos[self.osd_id]:
+                if (
+                    self.osdmap.epoch != epoch0
+                    or list(pg.acting) != acting0
+                ):
+                    return False  # stale interval: don't touch data
+                self.log.info(
+                    "pg", f"{pg.pool}/{pg.pgid}:", "peering: osd.",
+                    best, "has the authoritative log", infos[best],
+                    "over mine", infos[self.osd_id], "- rewinding self"
+                )
+                self._rewind_self(pg, spec, my_pos, best)
+            # current-interval check BEFORE activation: activating a
+            # superseded interval would stamp les for membership this
+            # election never judged
+            if self.osdmap.epoch != epoch0 or list(pg.acting) != acting0:
+                return False  # the newer map's kick re-runs
+            # activate: les := this map epoch, durable on me and every
+            # reachable member (a partitioned member keeps its old les
+            # — that is what future elections rank it down by)
+            self._pgmeta_write_les(
+                spec.pool_id, pg.pgid, epoch0, acting=acting0
+            )
+            for osd in acting0:
+                if osd in (SHARD_NONE, self.osd_id):
+                    continue
+                try:
+                    self.peers.activate_pg(
+                        osd, spec.pool_id, pg.pgid, epoch0
+                    )
+                except Exception:
+                    pass
+            self.log.info(
+                "pg", f"{pg.pool}/{pg.pgid}:", "peered at epoch",
+                epoch0, "(authority: osd.", best, ")"
+            )
+            return True
+        except Exception as e:
+            self.log.error(
+                "pg", f"{pg.pool}/{pg.pgid}:", "peering failed",
+                f"({type(e).__name__}: {e}); gate stays closed"
+            )
+            return False
+
+    def _rewind_self(
+        self, pg: _PG, spec, my_pos: int, best: int
+    ) -> None:
+        """Rewind my own shard against the elected authority: adopt
+        its per-object eversions as the judgment source, roll back my
+        objects whose stamps are not in its history, remove my
+        divergent creates (PGLog::rewind_divergent_log applied to the
+        ex-primary itself)."""
+        listing = self.peers.list_pg(
+            best, spec.pool_id, spec.pg_num, pg.pgid
+        )
+        auth: dict[str, tuple[int, tuple[int, int]]] = {}
+        for loc, _si, size, *ev in listing:
+            aev = tuple(ev) if len(ev) == 2 else (0, 0)
+            if loc not in auth or aev > auth[loc][1]:
+                auth[loc] = (size, aev)
+        # my own pristine stamps, BEFORE any recovery can overwrite
+        mine = []
+        for loc, si in self._scan_pg_keys(
+            spec.pool_id, spec.pg_num, pg.pgid
+        ):
+            if si != my_pos:
+                continue
+            try:
+                size, ev = parse_oi(
+                    self.store.getattr(shard_key(loc, si), OI_KEY)
+                )
+            except (FileNotFoundError, KeyError, ValueError):
+                continue
+            mine.append((loc, tuple(ev)))
+        # adopt the authority's knowledge: later judgments (returning
+        # replicas, reads priming sizes) must answer from the elected
+        # history, not from my divergent attrs
+        for loc, (size, aev) in auth.items():
+            if aev != (0, 0):
+                pg.rmw.prime_object(
+                    loc, max(size, 0), eversion=aev
+                )
+        for loc, mev in mine:
+            if mev == (0, 0):
+                continue  # pre-eversion stamp: nothing to judge
+            entry = auth.get(loc)
+            if entry is None:
+                # divergent create: only I ever heard of it
+                self.log.info(
+                    "pg", f"{pg.pool}/{pg.pgid}:",
+                    "peering: divergent create", loc, "- removing"
+                )
+                key = shard_key(loc, my_pos)
+                self.store.queue_transactions(
+                    Transaction().touch(key).remove(key)
+                )
+                pg.rmw.forget_object(loc)
+            elif entry[1] != mev:
+                self.log.info(
+                    "pg", f"{pg.pool}/{pg.pgid}:",
+                    "peering: divergent object", loc,
+                    "- rolling back from survivors"
+                )
+                # NO QoS admission here: admission grants fire on the
+                # worker thread, which may itself be parked in the
+                # peering gate — peering is control plane and must
+                # never wait on the data plane
+                pg.recovery.recover_object(loc, {my_pos})
+
     def _object_size(self, pg: _PG, oid: str) -> int:
         size = pg.rmw.object_size(oid)
         if size:
@@ -1037,6 +1459,12 @@ class OSDDaemon:
             serve_get_attrs(self.store, self.osd_id, conn, msg)
         elif isinstance(msg, PGList):
             self._handle_pg_list(conn, msg)
+        elif isinstance(msg, PGInfo):
+            self._handle_pg_info(conn, msg)
+        elif isinstance(msg, PGActivate):
+            self._handle_pg_activate(conn, msg)
+        elif isinstance(msg, BackfillReserve):
+            self._handle_backfill_reserve(conn, msg)
         elif isinstance(msg, OSDOp):
             self._handle_client_op(conn, msg)
         elif isinstance(msg, NotifyAck):
@@ -1147,6 +1575,16 @@ class OSDDaemon:
         if self.osdmap.primary(msg.pool, msg.oid) != self.osd_id:
             return OSDOpReply(msg.tid, epoch, error="eagain")
         pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
+        # peering gate: a primary that has not finished this
+        # interval's authoritative-log election must not serve — its
+        # own store may hold divergent state (the returning
+        # ex-primary). Ops WAIT briefly (the reference queues ops on
+        # a peering PG until it activates, waiting_for_peered), then
+        # eagain for the client's resend backoff. Peering never
+        # depends on this worker thread (no QoS admission on the
+        # rewind path), so the wait cannot deadlock.
+        if not self._get_pg(msg.pool, pgid).peered.wait(timeout=5.0):
+            return OSDOpReply(msg.tid, epoch, error="eagain")
         client_oid = msg.oid
         msg.oid = make_loc(spec.pool_id, msg.oid)  # pool-scoped store key
         # watch/notify live OUTSIDE the op lock: a notify waits for
@@ -1829,6 +2267,28 @@ class OSDDaemon:
         except Exception:
             return False
 
+    def _handle_backfill_reserve(
+        self, conn: Connection, msg: BackfillReserve
+    ) -> None:
+        """Remote-reservation service (the MBackfillReserve target
+        side): a request's GRANT reply may be delayed until a slot
+        frees — the requesting primary blocks in reserve_backfill,
+        which is exactly the throttle."""
+        key = (msg.pool_id, msg.pgid)
+        if msg.action == "release":
+            self.remote_reserver.release(key)
+            conn.send(BackfillReserveReply(msg.tid, msg.shard, True))
+            return
+
+        def grant(conn=conn, tid=msg.tid, shard=msg.shard) -> None:
+            try:
+                conn.send(BackfillReserveReply(tid, shard, True))
+            except Exception:
+                # requester gone: free the slot for the next in line
+                self.remote_reserver.release(key)
+
+        self.remote_reserver.request(key, msg.prio, grant)
+
     def _start_backfill(self, pool: str, pgid: int, pg: _PG) -> None:
         key = (pool, pgid)
         with self._pg_lock:
@@ -1850,6 +2310,15 @@ class OSDDaemon:
         self._maybe_gc_pools()
         self._maybe_schedule_scrubs()
         self._gc_dropped_snaps()
+        # a failed peering pass leaves the gate closed; retry here
+        with self._pg_lock:
+            stuck = [
+                pg for pg in self._pgs.values()
+                if not pg.peered.is_set() and not pg._peering
+                and first_live(pg.acting) == self.osd_id
+            ]
+        for pg in stuck:
+            self._kick_peering(pg)
 
     # -- background scrub scheduler (osd/scrubber/osd_scrub.cc role) ----
     def _scrub_due(
@@ -1995,7 +2464,59 @@ class OSDDaemon:
         """Move every object of the PG to its CRUSH target layout,
         then drop pg_temp (the reference's backfill machinery:
         interval scan + push, last_backfill semantics collapsed to a
-        dirty-set re-pass + final quiesce under the op lock)."""
+        dirty-set re-pass + final quiesce under the op lock).
+
+        Reservation protocol (backfill_reservation.rst): a LOCAL slot
+        from my reserver first, then a REMOTE slot from every
+        reachable backfill target; only then does data move. A target
+        whose remote reserver is full delays its grant — this thread
+        waits, which IS the cluster-wide throttle. All slots release
+        on exit (success or failure)."""
+        key = (pool, pgid)
+        local_granted = threading.Event()
+        self.local_reserver.request(key, 0, local_granted.set)
+        remote_reserved: list[int] = []
+        try:
+            if not local_granted.wait(timeout=60):
+                raise RuntimeError("local backfill slot never granted")
+            spec0 = self.osdmap.pools[pool]
+            targets = sorted(
+                set(self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True))
+                - {SHARD_NONE, self.osd_id}
+            )
+            for osd in targets:
+                if osd not in self.peers.avail_shards():
+                    continue  # pushes to it will fail+retry anyway
+                # track BEFORE the RPC: a timed-out request may still
+                # be queued (or later granted) at the target — the
+                # finally must release/cancel it either way, or the
+                # slot leaks when this backfill never retries
+                remote_reserved.append(osd)
+                if not self.peers.reserve_backfill(
+                    osd, spec0.pool_id, pgid, 0, timeout=60.0
+                ):
+                    raise RuntimeError(
+                        f"osd.{osd} backfill reservation not granted"
+                    )
+            self._backfill_pg_reserved(pool, pgid, pg)
+        except Exception:
+            # survivors short / peer died / reservation timed out:
+            # keep pg_temp (the PG stays served from the old layout);
+            # tick() retries
+            pg.backfilling = False
+        finally:
+            for osd in remote_reserved:
+                try:
+                    self.peers.release_backfill(
+                        osd, spec0.pool_id, pgid
+                    )
+                except Exception:
+                    pass
+            self.local_reserver.release(key)
+
+    def _backfill_pg_reserved(
+        self, pool: str, pgid: int, pg: _PG
+    ) -> None:
         try:
             spec = self.osdmap.pools[pool]
             # pass 1: scan + move everything currently known

@@ -154,6 +154,14 @@ class Incremental:
     del_pg_temp: tuple[tuple[str, int], ...] = ()
     #: crush rule installs: ((name, ((step, ...), ...)), ...)
     new_rules: tuple[tuple[str, tuple[tuple, ...]], ...] = ()
+    #: central config db edits: ((who, name, value-or-None), ...) —
+    #: the ConfigMonitor analog (mon/ConfigMonitor.h:15). ``who`` is
+    #: "" (global), "osd" (class), or "osd.N"; None value removes.
+    #: Riding the map incremental gives the config db the same
+    #: Paxos replication, epoch ordering, and subscription push the
+    #: map itself has (the reference pairs MConfig with MOSDMap on
+    #: the same monitor).
+    new_config: tuple[tuple[str, str, "str | None"], ...] = ()
 
     def to_bytes(self) -> bytes:
         return json.dumps({
@@ -177,6 +185,7 @@ class Incremental:
                 [n, [list(s) for s in steps]]
                 for n, steps in self.new_rules
             ],
+            "new_config": [list(c) for c in self.new_config],
         }).encode()
 
     @classmethod
@@ -204,6 +213,10 @@ class Incremental:
                 (n, tuple(tuple(s) for s in steps))
                 for n, steps in o.get("new_rules", ())
             ),
+            tuple(
+                (who, name, val)
+                for who, name, val in o.get("new_config", ())
+            ),
         )
 
 
@@ -218,6 +231,7 @@ class OSDMap:
         profiles: dict[str, dict[str, str]] | None = None,
         pg_temp: dict[tuple[str, int], tuple[int, ...]] | None = None,
         crush_rules: dict[str, tuple] | None = None,
+        config: dict[tuple[str, str], str] | None = None,
     ) -> None:
         self.epoch = epoch
         self.osds: dict[int, OSDInfo] = dict(osds or {})
@@ -235,6 +249,11 @@ class OSDMap:
             n: tuple(tuple(s) for s in steps)
             for n, steps in (crush_rules or {}).items()
         }
+        #: central config db: (who, name) -> value — the mon-
+        #: replicated option store (ConfigMonitor analog); daemons
+        #: apply their slice into the process config's "mon" layer on
+        #: every map they learn
+        self.config: dict[tuple[str, str], str] = dict(config or {})
         # straw2 input: in-devices with positive weight. Down-but-in
         # devices STAY (holes, not movement).
         self._crush = CrushMap([
@@ -373,8 +392,14 @@ class OSDMap:
         rules = dict(self.crush_rules)
         for name, steps in incr.new_rules:
             rules[name] = tuple(tuple(s) for s in steps)
+        cfg = dict(self.config)
+        for who, name, val in incr.new_config:
+            if val is None:
+                cfg.pop((who, name), None)
+            else:
+                cfg[(who, name)] = val
         return OSDMap(
-            self.epoch + 1, osds, pools, profiles, pg_temp, rules
+            self.epoch + 1, osds, pools, profiles, pg_temp, rules, cfg
         )
 
     # -- serialization --------------------------------------------------
@@ -391,6 +416,10 @@ class OSDMap:
             "crush_rules": [
                 [n, [list(s) for s in steps]]
                 for n, steps in self.crush_rules.items()
+            ],
+            "config": [
+                [who, name, val]
+                for (who, name), val in self.config.items()
             ],
         }).encode()
 
@@ -409,6 +438,10 @@ class OSDMap:
             {
                 n: tuple(tuple(s) for s in steps)
                 for n, steps in o.get("crush_rules", ())
+            },
+            {
+                (who, name): val
+                for who, name, val in o.get("config", ())
             },
         )
 

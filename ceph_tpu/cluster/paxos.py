@@ -31,8 +31,24 @@ ranks break ties, giving every proposer a disjoint pn space.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from dataclasses import dataclass, field
+
+#: propose() gives up (QuorumLost) after this many prepare/accept
+#: rounds: an unbounded retry loop livelocks when two proposers keep
+#: refusing each other's pn (round-4 advisor finding). The cap is
+#: deliberately high and the backoff jittered: with randomized
+#: desynchronization, dueling proposers converge in a handful of
+#: rounds, so hitting the cap means something is genuinely wedged.
+#: CAVEAT (inherent to Paxos, same as the reference's mon): a
+#: round-capped abort cannot prove its value was never accepted — a
+#: minority accept can still be resurrected and chosen by a rival's
+#: prepare. Callers retrying after this QuorumLost must go through
+#: the at-most-once machinery (mon_quorum's pending-blob check), not
+#: blind re-submission.
+PROPOSE_MAX_ROUNDS = 256
 
 
 class QuorumLost(Exception):
@@ -183,7 +199,12 @@ class PaxosNode:
             committed = self._slot(slot).committed
         if committed is not None:
             return committed
-        while True:
+        for round_no in range(PROPOSE_MAX_ROUNDS):
+            if round_no:
+                # jittered backoff: two live proposers refusing each
+                # other's pn forever is the classic Paxos livelock;
+                # desynchronizing the rounds lets one win
+                time.sleep(random.uniform(0, 0.002 * round_no))
             pn = self._next_pn()
             # phase 1: prepare / collect
             promises = 0
@@ -231,6 +252,10 @@ class PaxosNode:
                     )
                 return chosen
             # lost a race: retry with a higher pn
+        raise QuorumLost(
+            f"rank {self.rank}: slot {slot} undecided after "
+            f"{PROPOSE_MAX_ROUNDS} propose rounds (dueling proposers)"
+        )
 
 
 class MonCluster:

@@ -820,8 +820,13 @@ class ClayCodec(ErasureCodeBase):
 
         n = self.q * self.t
         zsel = np.asarray(planes)
+        # host path keeps numpy: the inner decode's dispatch then
+        # serves small ops from host GF tables and ROUTES large ones
+        # (mesh/DCN take host-staged inputs only); converting to
+        # device arrays here barred both and forced einsum
+        conv = jnp.asarray if traced else np.ascontiguousarray
         known = {
-            node: jnp.asarray(U[node][..., zsel, :])
+            node: conv(U[node][..., zsel, :])
             for node in range(n)
             if node not in erasures
         }

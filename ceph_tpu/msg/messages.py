@@ -39,6 +39,12 @@ MSG_NOTIFY_ACK = 121          # watcher ack back to the primary
 MSG_DCN_HELLO = 122           # DCN worker-host handshake
 MSG_DCN_CMD = 123             # DCN control-plane op broadcast
 MSG_DCN_REPLY = 124           # DCN per-host op result
+MSG_PG_INFO = 125             # peering info exchange (MOSDPGInfo)
+MSG_PG_INFO_REPLY = 126
+MSG_PG_ACTIVATE = 127         # interval activation (les push)
+MSG_PG_ACTIVATE_ACK = 128
+MSG_BACKFILL_RESERVE = 129    # MBackfillReserve (request/release)
+MSG_BACKFILL_RESERVE_REPLY = 130
 
 VERSION = 1
 
@@ -379,6 +385,193 @@ class PGListReply:
 
 
 @dataclass
+class PGInfo:
+    """Ask a peer for its pg_info_t analog for one PG: the interval
+    ledger (last_epoch_started) plus its log head (last_update = max
+    committed eversion over its shard copies). The peering info
+    exchange (MOSDPGInfo / PeeringState::proc_replica_info) that
+    feeds authoritative-log election (find_best_info,
+    osd/PeeringState.cc:1565). Answered from the peer's STORE, not
+    its in-memory PG (the peer may not have instantiated one)."""
+
+    tid: int
+    shard: int  # echo key for reply routing (the peer's osd id)
+    pool_id: int
+    pg_num: int
+    pgid: int
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "pg_info",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "pool_id": self.pool_id,
+                    "pg_num": self.pg_num,
+                    "pgid": self.pgid,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "PGInfo":
+        h = _parse(segments[0], "pg_info")
+        return cls(h["tid"], h["shard"], h["pool_id"], h["pg_num"], h["pgid"])
+
+
+@dataclass
+class PGInfoReply:
+    """(last_epoch_started, last_update) for one PG on one peer."""
+
+    tid: int
+    shard: int
+    les: int
+    lu_epoch: int
+    lu_tid: int
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "pg_info_reply",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "les": self.les,
+                    "lu_epoch": self.lu_epoch,
+                    "lu_tid": self.lu_tid,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "PGInfoReply":
+        h = _parse(segments[0], "pg_info_reply")
+        return cls(
+            h["tid"], h["shard"], h["les"], h["lu_epoch"], h["lu_tid"]
+        )
+
+
+@dataclass
+class PGActivate:
+    """Interval activation push: after the elected primary finishes
+    peering at map epoch E, every up member records
+    last_epoch_started = E in its own durable pgmeta — the
+    PeeringState::activate / MOSDPGLog activation role. A member that
+    misses this push (partitioned) keeps its old les, which is
+    exactly what makes a later election rank it non-authoritative."""
+
+    tid: int
+    shard: int
+    pool_id: int
+    pgid: int
+    epoch: int
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "pg_activate",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "pool_id": self.pool_id,
+                    "pgid": self.pgid,
+                    "epoch": self.epoch,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "PGActivate":
+        h = _parse(segments[0], "pg_activate")
+        return cls(
+            h["tid"], h["shard"], h["pool_id"], h["pgid"], h["epoch"]
+        )
+
+
+@dataclass
+class PGActivateAck:
+    tid: int
+    shard: int
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "pg_activate_ack", {"tid": self.tid, "shard": self.shard}
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "PGActivateAck":
+        h = _parse(segments[0], "pg_activate_ack")
+        return cls(h["tid"], h["shard"])
+
+
+@dataclass
+class BackfillReserve:
+    """The MBackfillReserve analog (backfill_reservation.rst): a
+    backfill primary asks each target OSD for a remote slot before
+    moving data; ``action`` is "request" or "release". The reply to a
+    request may be DELAYED — the target's remote AsyncReserver grants
+    it when a slot frees, so a busy target throttles the primary
+    instead of rejecting it."""
+
+    tid: int
+    shard: int
+    action: str  # NOT "kind": that key frames the message envelope
+    pool_id: int
+    pgid: int
+    prio: int = 0
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "backfill_reserve",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "action": self.action,
+                    "pool_id": self.pool_id,
+                    "pgid": self.pgid,
+                    "prio": self.prio,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "BackfillReserve":
+        h = _parse(segments[0], "backfill_reserve")
+        return cls(
+            h["tid"], h["shard"], h["action"], h["pool_id"], h["pgid"],
+            h["prio"],
+        )
+
+
+@dataclass
+class BackfillReserveReply:
+    tid: int
+    shard: int
+    granted: bool = True
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "backfill_reserve_reply",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "granted": self.granted,
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "BackfillReserveReply":
+        h = _parse(segments[0], "backfill_reserve_reply")
+        return cls(h["tid"], h["shard"], h["granted"])
+
+
+@dataclass
 class GetAttrs:
     """Fetch named attrs from one shard's store — the getattr sub-op
     (the extension point deep scrub needs to vote on HashInfo copies
@@ -606,6 +799,12 @@ _DECODERS = {
     MSG_DCN_HELLO: DcnHello.decode,
     MSG_DCN_CMD: DcnCmd.decode,
     MSG_DCN_REPLY: DcnReply.decode,
+    MSG_PG_INFO: PGInfo.decode,
+    MSG_PG_INFO_REPLY: PGInfoReply.decode,
+    MSG_PG_ACTIVATE: PGActivate.decode,
+    MSG_PG_ACTIVATE_ACK: PGActivateAck.decode,
+    MSG_BACKFILL_RESERVE: BackfillReserve.decode,
+    MSG_BACKFILL_RESERVE_REPLY: BackfillReserveReply.decode,
 }
 
 _TYPE_OF = {
@@ -626,6 +825,12 @@ _TYPE_OF = {
     DcnHello: MSG_DCN_HELLO,
     DcnCmd: MSG_DCN_CMD,
     DcnReply: MSG_DCN_REPLY,
+    PGInfo: MSG_PG_INFO,
+    PGInfoReply: MSG_PG_INFO_REPLY,
+    PGActivate: MSG_PG_ACTIVATE,
+    PGActivateAck: MSG_PG_ACTIVATE_ACK,
+    BackfillReserve: MSG_BACKFILL_RESERVE,
+    BackfillReserveReply: MSG_BACKFILL_RESERVE_REPLY,
 }
 
 

@@ -38,8 +38,14 @@ from .messages import (
     ECSubReadReply,
     ECSubWrite,
     ECSubWriteReply,
+    BackfillReserve,
+    BackfillReserveReply,
     GetAttrs,
     GetAttrsReply,
+    PGActivate,
+    PGActivateAck,
+    PGInfo,
+    PGInfoReply,
     PGList,
     PGListReply,
     Ping,
@@ -128,14 +134,19 @@ class ShardServer:
 
 
 class _Pending:
-    __slots__ = ("shard", "oid", "on_reply", "deadline", "is_read")
+    __slots__ = ("shard", "oid", "on_reply", "deadline", "is_read", "soft")
 
-    def __init__(self, shard, oid, on_reply, deadline, is_read):
+    def __init__(self, shard, oid, on_reply, deadline, is_read,
+                 soft=False):
         self.shard = shard
         self.oid = oid
         self.on_reply = on_reply
         self.deadline = deadline
         self.is_read = is_read
+        #: soft RPCs are EXPECTED to wait (delayed reservation
+        #: grants): expiry wakes the waiter but must not mark the
+        #: merely-busy peer down
+        self.soft = soft
 
 
 class NetShardBackend:
@@ -195,7 +206,8 @@ class NetShardBackend:
             return
         if not isinstance(
             msg,
-            (ECSubWriteReply, ECSubReadReply, PGListReply, GetAttrsReply),
+            (ECSubWriteReply, ECSubReadReply, PGListReply, GetAttrsReply,
+             PGInfoReply, PGActivateAck, BackfillReserveReply),
         ):
             return  # a reflected request must never satisfy an RPC
         with self._lock:
@@ -203,11 +215,16 @@ class NetShardBackend:
         if entry is not None:
             self._inbox.put(lambda: entry.on_reply(msg))
 
-    def _register(self, tid, shard, oid, on_reply, is_read) -> None:
+    def _register(
+        self, tid, shard, oid, on_reply, is_read,
+        deadline=None, soft=False,
+    ) -> None:
         with self._lock:
             self._waiting[(tid, shard)] = _Pending(
-                shard, oid, on_reply, time.monotonic() + self.timeout,
-                is_read,
+                shard, oid, on_reply,
+                deadline if deadline is not None
+                else time.monotonic() + self.timeout,
+                is_read, soft,
             )
 
     def _send(self, shard: int, msg, tid: int) -> bool:
@@ -233,9 +250,12 @@ class NetShardBackend:
                     expired.append((key, entry))
                     del self._waiting[key]
         for (tid, shard), entry in expired:
-            if shard not in self.down_shards:
-                self._log.info("shard", shard, "marked down (rpc timeout)")
-            self.down_shards.add(shard)
+            if not entry.soft:
+                if shard not in self.down_shards:
+                    self._log.info(
+                        "shard", shard, "marked down (rpc timeout)"
+                    )
+                self.down_shards.add(shard)
             if entry.is_read:
                 from ceph_tpu.pipeline.read import ShardReadError
 
@@ -359,6 +379,93 @@ class NetShardBackend:
         if isinstance(result, Exception):
             raise result
         return result.oids
+
+    def get_pg_info(
+        self, shard: int, pool_id: int, pg_num: int, pgid: int
+    ) -> tuple[int, tuple[int, int]]:
+        """Synchronous peering info fetch: the peer's
+        (last_epoch_started, last_update) for one PG, answered from
+        its durable store (proc_replica_info's data source)."""
+        tid = next(self._tids)
+        out: dict[str, object] = {}
+        self._register(
+            tid, shard, "", lambda r: out.update(r=r), is_read=True
+        )
+        if not self._send(
+            shard, PGInfo(tid, shard, pool_id, pg_num, pgid), tid
+        ):
+            raise ConnectionError(f"osd.{shard} unreachable for pg info")
+        self.drain_until(lambda: "r" in out, timeout=self.timeout + 5)
+        result = out["r"]
+        if isinstance(result, Exception):
+            raise result
+        return result.les, (result.lu_epoch, result.lu_tid)
+
+    def activate_pg(
+        self, shard: int, pool_id: int, pgid: int, epoch: int
+    ) -> bool:
+        """Push an interval activation (les=epoch) to one member;
+        waits for the ack so the les write is durable before the
+        primary starts serving. Returns False when the member is
+        unreachable (it keeps its stale les — by design)."""
+        tid = next(self._tids)
+        out: dict[str, object] = {}
+        self._register(
+            tid, shard, "", lambda r: out.update(r=r), is_read=True
+        )
+        if not self._send(
+            shard, PGActivate(tid, shard, pool_id, pgid, epoch), tid
+        ):
+            return False
+        try:
+            self.drain_until(lambda: "r" in out, timeout=self.timeout)
+        except TimeoutError:
+            return False
+        return not isinstance(out.get("r"), Exception)
+
+    def reserve_backfill(
+        self, shard: int, pool_id: int, pgid: int, prio: int,
+        timeout: float,
+    ) -> bool:
+        """Ask a backfill target for a remote reservation slot. The
+        grant may be DELAYED while the target's remote reserver is
+        full — ``timeout`` bounds the wait; False means unreachable
+        or not granted in time (the caller backs off and retries)."""
+        tid = next(self._tids)
+        out: dict[str, object] = {}
+        # soft + per-call deadline: a full target DELAYS its grant by
+        # design, so the generic RPC expiry must neither cut the wait
+        # short nor mark the healthy-but-busy peer down
+        self._register(
+            tid, shard, "", lambda r: out.update(r=r), is_read=True,
+            deadline=time.monotonic() + timeout, soft=True,
+        )
+        if not self._send(
+            shard,
+            BackfillReserve(tid, shard, "request", pool_id, pgid, prio),
+            tid,
+        ):
+            return False
+        try:
+            self.drain_until(lambda: "r" in out, timeout=timeout)
+        except TimeoutError:
+            return False
+        r = out.get("r")
+        return (
+            not isinstance(r, Exception)
+            and getattr(r, "granted", False)
+        )
+
+    def release_backfill(self, shard: int, pool_id: int, pgid: int) -> None:
+        """Fire-and-forget remote-slot release (acked, but the caller
+        has nothing to do with the ack)."""
+        tid = next(self._tids)
+        self._register(tid, shard, "", lambda r: None, is_read=True)
+        self._send(
+            shard,
+            BackfillReserve(tid, shard, "release", pool_id, pgid),
+            tid,
+        )
 
     def get_attrs_async(
         self, shard: int, oid: str, names: list[str], cb

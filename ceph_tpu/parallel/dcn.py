@@ -178,15 +178,28 @@ def _worker_main(argv: list[str]) -> None:
             if v != before.get(kk, 0)
         }
         # The output is replicated over sp (out_specs P("dp", ...)):
-        # this host's addressable shards cover the WHOLE result.
-        full = _assemble_addressable(out)
-        if packets:  # de-packetize on the host copy
-            full = full.reshape(b, full.shape[1] // codec.w, n)
+        # this host's addressable shards cover the WHOLE result — but
+        # the coordinator reads only rank 0's copy, so nonzero ranks
+        # ACK with metadata after syncing (the _run_apply discipline;
+        # shipping (n_hosts-1)x the output bytes bought nothing).
+        if args.rank == 0:
+            full = _assemble_addressable(out)
+            if packets:  # de-packetize on the host copy
+                full = full.reshape(b, full.shape[1] // codec.w, n)
+            return DcnReply(
+                cmd.tid, args.rank,
+                {"ok": True, "counters": delta,
+                 "shape": list(full.shape), "hosts": args.nprocs},
+                full.tobytes(),
+            )
+        out.block_until_ready()
+        oshape = [b, out.shape[1] // codec.w, n] if packets else [
+            b, out.shape[1], out.shape[2]
+        ]
         return DcnReply(
             cmd.tid, args.rank,
-            {"ok": True, "counters": delta, "shape": list(full.shape),
+            {"ok": True, "counters": delta, "shape": oshape,
              "hosts": args.nprocs},
-            full.tobytes(),
         )
 
     def _run_apply(cmd: DcnCmd) -> DcnReply:

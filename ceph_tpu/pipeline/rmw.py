@@ -678,6 +678,16 @@ class RMWPipeline:
     def object_size(self, oid: str) -> int:
         return self._object_sizes.get(oid, 0)
 
+    def forget_object(self, oid: str) -> None:
+        """Drop all in-memory per-object state — the peering
+        divergent-create removal path: the object never existed in
+        authoritative history, so no trace of the divergent stamps
+        may survive to answer later authority lookups."""
+        self._object_sizes.pop(oid, None)
+        self._hinfo.pop(oid, None)
+        self._eversions.pop(oid, None)
+        self._live_eversions.pop(oid, None)
+
     def object_eversion(self, oid: str) -> tuple[int, int] | None:
         """Last known (epoch, tid) stamp — may come from a stored
         attr (prime_object); use live_eversion when trust matters."""

@@ -280,21 +280,14 @@ def test_live_history_beats_stale_plurality(cluster):
 
 
 # -- background scrub scheduling (osd/scrubber/osd_scrub.cc role) --------
-def _scrub_config(monkeypatch_vals):
+def _scrub_config(vals):
+    """config.override: restores prior state INCLUDING absence —
+    writing the saved effective value back with config.set() would
+    pin defaults into the runtime layer, masking lower layers (the
+    mon config db) for every later test in the process."""
     from ceph_tpu.utils import config
 
-    saved = {}
-    for k, v in monkeypatch_vals.items():
-        saved[k] = config.get(k)
-        config.set(k, v)
-    return saved
-
-
-def _restore_config(saved):
-    from ceph_tpu.utils import config
-
-    for k, v in saved.items():
-        config.set(k, v)
+    return config.override(**vals)
 
 
 def test_scheduler_finds_and_repairs_bitrot(cluster):
@@ -305,12 +298,11 @@ def test_scheduler_finds_and_repairs_bitrot(cluster):
     import time
 
     mon, daemons, client = cluster
-    saved = _scrub_config({
+    with _scrub_config({
         "osd_scrub_min_interval": 0.05,
         "osd_deep_scrub_interval": 0.05,
         "osd_scrub_auto_repair": True,
-    })
-    try:
+    }):
         io = client.open_ioctx("ecpool")
         data = payload(9_000)
         io.write("obj", data)
@@ -342,8 +334,6 @@ def test_scheduler_finds_and_repairs_bitrot(cluster):
         (res,) = run_scrub(mon, daemons, "obj")
         assert res.ok, res.errors
         assert osd is not None
-    finally:
-        _restore_config(saved)
 
 
 def test_scheduler_stamps_and_shallow_deep_cadence(cluster):
@@ -352,13 +342,12 @@ def test_scheduler_stamps_and_shallow_deep_cadence(cluster):
     import time
 
     mon, daemons, client = cluster
-    saved = _scrub_config({
+    with _scrub_config({
         "osd_scrub_min_interval": 0.05,
         "osd_deep_scrub_interval": 1e6,
         "osd_deep_scrub_randomize_ratio": 0.0,
         "osd_scrub_auto_repair": False,
-    })
-    try:
+    }):
         io = client.open_ioctx("ecpool")
         io.write("obj", payload(5_000))
         pgid = mon.osdmap.object_to_pg("ecpool", "obj")
@@ -382,8 +371,6 @@ def test_scheduler_stamps_and_shallow_deep_cadence(cluster):
                 break
             time.sleep(0.02)
         assert hist and hist[1] == "shallow", hist
-    finally:
-        _restore_config(saved)
 
 
 def test_truncated_object_scrubs_clean_and_repairs(cluster):

@@ -1,0 +1,144 @@
+"""ConfigMonitor analog — the mon-replicated central config db
+(mon/ConfigMonitor.h:15): ``config set`` commits through the monitor
+(or a live quorum), rides the map channel to every subscribed daemon,
+lands in the process config's "mon" layer, and fires observers.
+Local file/env/runtime layers override the mon db (the reference's
+local-emergency-override precedence)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+from ceph_tpu.cluster.monitor import CommandError
+from ceph_tpu.utils import config
+
+
+@pytest.fixture
+def cluster():
+    mon = Monitor()
+    daemons = []
+    for i in range(3):
+        mon.osd_crush_add(i, zone=f"z{i}")
+    for i in range(3):
+        d = OSDDaemon(i, mon, chunk_size=1024)
+        d.start()
+        daemons.append(d)
+    mon.osd_erasure_code_profile_set(
+        "rs21", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": "2", "m": "1"}
+    )
+    mon.osd_pool_create("pool", 4, "rs21")
+    client = RadosClient(mon, backoff=0.01)
+    yield mon, daemons, client
+    client.shutdown()
+    for d in daemons:
+        d.stop()
+    for name in ("osd_scrub_min_interval", "ec_use_sched"):
+        config.rm(name, layer="mon")
+
+
+def _wait(pred, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_config_set_reaches_live_daemon(cluster):
+    """The VERDICT done-criterion: ``config set`` via the mon changes
+    a live OSD's effective option."""
+    mon, daemons, client = cluster
+    assert config.get("osd_scrub_min_interval") == 86400.0
+    mon.config_set("osd_scrub_min_interval", "123.5", who="osd")
+    assert _wait(
+        lambda: config.get("osd_scrub_min_interval") == 123.5
+    ), "mon config never reached the daemons"
+    assert config.get_source("osd_scrub_min_interval") == "mon"
+    # removal restores the default
+    mon.config_rm("osd_scrub_min_interval", who="osd")
+    assert _wait(
+        lambda: config.get("osd_scrub_min_interval") == 86400.0
+    )
+
+
+def test_observers_fire_on_mon_push(cluster):
+    mon, daemons, client = cluster
+    seen = []
+    config.add_observer("osd_scrub_min_interval", lambda n, v: seen.append(v))
+    mon.config_set("osd_scrub_min_interval", "55", who="")
+    assert _wait(lambda: 55.0 in seen), "observer never fired"
+    mon.config_rm("osd_scrub_min_interval", who="")
+    assert _wait(lambda: 86400.0 in seen), "observer missed the rm"
+
+
+def test_local_layers_override_mon(cluster):
+    mon, daemons, client = cluster
+    mon.config_set("osd_scrub_min_interval", "77", who="")
+    assert _wait(lambda: config.get("osd_scrub_min_interval") == 77.0)
+    config.set("osd_scrub_min_interval", "99", layer="runtime")
+    try:
+        assert config.get("osd_scrub_min_interval") == 99.0
+        assert config.get_source("osd_scrub_min_interval") == "runtime"
+    finally:
+        config.rm("osd_scrub_min_interval", layer="runtime")
+    assert config.get("osd_scrub_min_interval") == 77.0
+
+
+def test_validation_and_scoping():
+    mon = Monitor()
+    with pytest.raises(CommandError, match="unknown option"):
+        mon.config_set("no_such_option", "1")
+    with pytest.raises(CommandError, match="invalid value"):
+        mon.config_set("osd_scrub_min_interval", "not-a-float")
+    with pytest.raises(CommandError, match="bad config target"):
+        mon.config_set("osd_scrub_min_interval", "1", who="weird.x")
+    mon.config_set("osd_scrub_min_interval", "5", who="osd.2")
+    assert mon.config_db() == {
+        "osd.2/osd_scrub_min_interval": "5"
+    }
+
+
+def test_config_db_replicates_through_quorum():
+    """The live-quorum path: config_set through a 3-rank Paxos quorum
+    lands in every rank's map (Paxos-replicated, not single-mon)."""
+    from ceph_tpu.cluster.mon_quorum import MonQuorumService, QuorumMonitor
+
+    svc = MonQuorumService(3)
+    qmon = QuorumMonitor(svc)
+    qmon.config_set("osd_scrub_min_interval", "42", who="osd")
+    for rank in range(3):
+        m = svc.monitors[rank]
+        assert m.osdmap.config.get(("osd", "osd_scrub_min_interval")) == "42", (
+            f"rank {rank} missed the replicated config entry"
+        )
+    # survives a leader kill: the db is in the replicated map
+    svc.kill(svc._leader_rank)
+    qmon.config_set("osd_scrub_min_interval", "43", who="osd")
+    live = [r for r in range(3) if r not in svc.dead]
+    for rank in live:
+        assert svc.monitors[rank].osdmap.config[
+            ("osd", "osd_scrub_min_interval")
+        ] == "43"
+
+
+def test_map_roundtrip_carries_config():
+    from ceph_tpu.cluster.osdmap import Incremental, OSDMap
+
+    m = OSDMap()
+    m2 = m.apply(Incremental(
+        epoch=1, new_config=(("", "ec_use_sched", "false"),)
+    ))
+    assert m2.config[("", "ec_use_sched")] == "false"
+    m3 = OSDMap.from_bytes(m2.to_bytes())
+    assert m3.config == m2.config
+    incr = Incremental(
+        epoch=2, new_config=(("", "ec_use_sched", None),)
+    )
+    incr2 = Incremental.from_bytes(incr.to_bytes())
+    assert incr2.new_config == (("", "ec_use_sched", None),)
+    m4 = m3.apply(incr2)
+    assert ("", "ec_use_sched") not in m4.config
