@@ -392,21 +392,26 @@ class Monitor:
         """``ceph config set <who> <name> <value>``: validate against
         the option schema, commit through the quorum, push to every
         subscribed daemon via the map channel."""
-        from ceph_tpu.utils.config import OPTIONS
+        from ceph_tpu.utils import config
 
         self._check_config_who(who)
-        opt = next((o for o in OPTIONS if o.name == name), None)
+        opt = config.schema.get(name)
         if opt is None:
             raise CommandError(f"unknown option {name!r}")
+        stored = str(value)
         try:
-            opt.parse(value)  # type/range/enum check, value unused
+            # validate the STRING that will be stored — daemons parse
+            # exactly this form out of the replicated db, so e.g. 8.5
+            # for an int option must be rejected here, not silently
+            # dropped by every daemon
+            opt.parse(stored)
         except Exception as e:
             raise CommandError(
                 f"invalid value for {name!r}: {e}"
             ) from None
         with self._command():
             return self._propose(
-                new_config=((who, name, str(value)),)
+                new_config=((who, name, stored),)
             )
 
     def config_rm(self, name: str, who: str = "") -> OSDMap:

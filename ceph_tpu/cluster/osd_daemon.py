@@ -455,6 +455,13 @@ class OSDDaemon:
         #: loc -> [(reqid, size)] rolling window mirroring the
         #: replicated REQ_KEY attr (seeded from storage on takeover)
         self._req_windows: dict[str, list] = {}
+        #: loc -> reqids seeded from a stored attr and not yet proven
+        #: durable. A dead primary may have stamped the attr on fewer
+        #: than k shards — such an op was never acked and is not
+        #: reconstructible, so replaying it as a success would lie to
+        #: the client (round-4 advisor finding). Entries leave the set
+        #: once a quorum poll proves >= k shards recorded them.
+        self._req_unverified: dict[str, set] = {}
         self._completed_cap = 1024
         self._stopped = False
         # -- background scrub scheduling (osd/scrubber/osd_scrub.cc):
@@ -579,31 +586,44 @@ class OSDDaemon:
             for (who, name), val in osdmap.config.items():
                 if who == scope:
                     eff[name] = val
+        applied: dict[str, str] = {}
         for name, val in eff.items():
-            if self._mon_cfg_applied.get(name) != val:
-                try:
-                    config.set(name, val, layer="mon")
-                except Exception as e:
-                    self.log.error(
-                        "mon config", name, "rejected:",
-                        type(e).__name__, str(e),
-                    )
+            if self._mon_cfg_applied.get(name) == val:
+                applied[name] = val
+                continue
+            try:
+                config.set(name, val, layer="mon")
+                applied[name] = val
+            except Exception as e:
+                # NOT recorded at the new value: the next map carrying
+                # it retries instead of silently diverging. A
+                # previously applied value stays recorded, so a later
+                # monitor-side rm still clears the stale layer entry.
+                if name in self._mon_cfg_applied:
+                    applied[name] = self._mon_cfg_applied[name]
+                self.log.error(
+                    "mon config", name, "rejected:",
+                    type(e).__name__, str(e),
+                )
         for name in set(self._mon_cfg_applied) - set(eff):
             try:
                 config.rm(name, layer="mon")
             except Exception:
                 pass
-        self._mon_cfg_applied = eff
+        self._mon_cfg_applied = applied
 
     def _on_map(self, osdmap: OSDMap) -> None:
         if self._stopped:
             return
-        self._apply_mon_config(osdmap)
         to_recover: list[tuple[_PG, list[int]]] = []
         to_release: list[tuple[_PG, list[int]]] = []
         with self._pg_lock:
             if osdmap.epoch < self.osdmap.epoch:
                 return  # late delivery from a racing notifier thread
+            # config applies AFTER the stale-epoch guard (a late old
+            # map must not revert newer values) and under _pg_lock so
+            # concurrent deliveries can't interleave apply/rm
+            self._apply_mon_config(osdmap)
             # pool identity is the ID (names are reusable, ids never
             # are) — and deletions accumulate so a skipped epoch or a
             # straggler write can't leak keys forever
@@ -1157,7 +1177,13 @@ class OSDDaemon:
                     continue
                 if self._pgmeta_acting(spec.pool_id, pgid) == acting:
                     continue  # interval unchanged since my activation
-                self._kick_peering(self._get_pg(pool, pgid))
+                existed = (pool, pgid) in self._pgs
+                pg = self._get_pg(pool, pgid)
+                if existed:
+                    # a freshly instantiated PG was already kicked by
+                    # _get_pg — kicking again would run the whole
+                    # PGInfo/activation round twice
+                    self._kick_peering(pg)
 
     def _own_pg_info(
         self, pool_id: int, pg_num: int, pgid: int
@@ -1596,6 +1622,7 @@ class OSDDaemon:
         if msg.op == "notify":
             return self._op_notify(msg, client_oid)
         with self._op_lock:
+            polled = None  # durability fan-out, shared consult->resolve
             if msg.op in _MUTATING_OPS and msg.reqid:
                 cached = self._completed_ops.get(msg.reqid)
                 if cached is not None:
@@ -1606,13 +1633,75 @@ class OSDDaemon:
                 # failover path: the replicated per-object window (the
                 # pg-log reqid role) survives the old primary — a
                 # resent append/write/truncate replays its recorded
-                # result instead of re-applying
+                # result instead of re-applying. A STORAGE-seeded
+                # entry must first prove durable: the dead primary may
+                # have stamped it on < k shards (never acked, not
+                # reconstructible) — replaying that as success loses
+                # the write (round-4 advisor finding).
                 pg0 = self._get_pg(msg.pool, pgid)
-                for rq, size in self._req_window(pg0, msg.oid):
-                    if rq == msg.reqid:
-                        return OSDOpReply(msg.tid, epoch, size=size)
+                hit = next(
+                    (t for t in self._req_window(pg0, msg.oid)
+                     if t[0] == msg.reqid), None
+                )
+                if hit is not None:
+                    unv = self._req_unverified.get(msg.oid)
+                    if unv and msg.reqid in unv:
+                        polled = self._poll_req_state(pg0, msg.oid)
+                        members = sum(
+                            1 for o in pg0.acting if o != SHARD_NONE
+                        )
+                        verdict = self._classify_req(
+                            polled[0], msg.reqid, pg0.rmw.sinfo.k,
+                            max(members - len(polled[0]), 0),
+                        )
+                    else:
+                        verdict = "durable"
+                    if verdict == "durable":
+                        if unv:
+                            unv.discard(msg.reqid)
+                        return OSDOpReply(msg.tid, epoch, size=hit[1])
+                    if verdict == "unknown":
+                        # unreachable members could still prove the
+                        # op durable — back off instead of guessing
+                        return OSDOpReply(
+                            msg.tid, epoch, error="eagain"
+                        )
+                    if verdict == "ambiguous":
+                        return OSDOpReply(
+                            msg.tid, epoch, error="eio",
+                            data=b"resent op is not durable and later "
+                                 b"writes exist (unfound analog)",
+                        )
+                    # "reapply": first attempt reached < k shards and
+                    # nothing newer exists anywhere — drop the seeded
+                    # entry and re-execute, healing the torn stripe.
+                    # An append re-applies at its ORIGINAL offset (the
+                    # recorded result size minus the payload), not the
+                    # current size a partial apply may have inflated.
+                    self.log.info(
+                        "op", msg.oid, "resend", msg.reqid,
+                        "not durable - re-applying"
+                    )
+                    self._req_windows[msg.oid] = [
+                        t for t in self._req_window(pg0, msg.oid)
+                        if t[0] != msg.reqid
+                    ]
+                    unv.discard(msg.reqid)
+                    if msg.op == "append":
+                        msg.op = "write"
+                        msg.offset = max(hit[1] - len(msg.data), 0)
             pg = self._get_pg(msg.pool, pgid)
             if msg.op in _MUTATING_OPS:
+                # settle storage-seeded reqid entries BEFORE anything
+                # reads this object's size or stamps its window: a
+                # torn never-acked write must be erased and rolled
+                # back, or an append would build on the inflated OI
+                # and a committed op's attr stamp would launder the
+                # entry to every shard (round-5 review finding)
+                if not self._resolve_unverified_reqs(
+                    pg, msg.oid, polled=polled
+                ):
+                    return OSDOpReply(msg.tid, epoch, error="eagain")
                 # copy-on-first-write after a pool snapshot: the head
                 # must be preserved as the newest snap's clone BEFORE
                 # any mutation lands (make_writeable role,
@@ -1703,10 +1792,229 @@ class OSDDaemon:
                     win = parse_reqs(self.store.getattr(key, REQ_KEY))
                 except (FileNotFoundError, KeyError, ValueError):
                     pass
+            if win:
+                # storage-seeded entries are suspect until a quorum
+                # poll proves them durable (see _verify_req_durable)
+                self._req_unverified[loc] = {t[0] for t in win}
             if len(self._req_windows) > 4096:
-                self._req_windows.pop(next(iter(self._req_windows)))
+                old = next(iter(self._req_windows))
+                self._req_windows.pop(old)
+                self._req_unverified.pop(old, None)
             self._req_windows[loc] = win
         return win
+
+    #: deadline for the one-shot durability fan-out (rare failover
+    #: path, but it runs under _op_lock — a full RPC timeout per
+    #: member would stall every client op on the daemon)
+    REQ_POLL_TIMEOUT = 2.5
+
+    def _poll_req_state(self, pg: _PG, loc: str):
+        """ONE async fan-out to the acting members for the object's
+        replicated REQ window + OI (the scrub-tally get_attrs_async
+        pattern — sequential sync RPCs under _op_lock stalled the
+        daemon for members that are slow exactly during failover).
+
+        Returns ``(windows, infos)``: parsed reqid windows from every
+        member that answered (self included, read locally), and the
+        OTHER members' (size, eversion) OIs — the rollback target
+        source."""
+        results: list = []
+        pending = 0
+        for si, osd in enumerate(pg.acting):
+            if osd == SHARD_NONE or osd == self.osd_id:
+                continue
+            key = shard_key(loc, si)
+            if self.peers.get_attrs_async(
+                osd, key, [REQ_KEY, OI_KEY],
+                lambda r, _o=osd: results.append(r),
+            ):
+                pending += 1
+        windows: list = []
+        infos: list = []
+        try:
+            key = self._my_key(pg, loc)
+            raw = self.store.getattr(key, REQ_KEY) if key else None
+            windows.append(parse_reqs(raw) if raw else [])
+        except (FileNotFoundError, KeyError, ValueError):
+            windows.append([])
+        try:
+            self.peers.drain_until(
+                lambda: len(results) >= pending,
+                timeout=self.REQ_POLL_TIMEOUT,
+            )
+        except TimeoutError:
+            pass  # best-effort deadline: classify from who answered
+        for r in results:
+            if isinstance(r, Exception):
+                continue  # unreachable: cannot vouch either way
+            if getattr(r, "error", None):
+                if r.error == "enoent":
+                    # a DEFINITIVE "no record at my position" is an
+                    # answer, not an absence of one: it votes an empty
+                    # window, or a torn create (stamped only on the
+                    # successor) would classify "unknown" forever and
+                    # wedge the object in eagain (round-5 review).
+                    # Safe even for an op committed at pre-remap
+                    # positions: re-apply is a fixed-offset write.
+                    windows.append([])
+                continue
+            attrs = r.attrs
+            try:
+                raw = attrs.get(REQ_KEY)
+                windows.append(parse_reqs(raw) if raw else [])
+            except ValueError:
+                windows.append([])
+            try:
+                raw = attrs.get(OI_KEY)
+                if raw:
+                    size, ev = parse_oi(raw)
+                    infos.append((size, tuple(ev)))
+            except ValueError:
+                pass
+        return windows, infos
+
+    @staticmethod
+    def _classify_req(
+        windows: list, reqid: str, k: int, unanswered: int = 0
+    ) -> str:
+        """Durability verdict for one suspect reqid over the polled
+        windows (round-4 advisor finding: a storage-seeded entry may
+        record an op the dead primary applied on fewer than k shards
+        — never acked to the client, not reconstructible).
+
+        ``"durable"``: >= k members recorded the reqid (sub-writes
+        apply in tid order per shard, so those k copies are at a
+        consistent version and any shard can be rebuilt).
+        ``"unknown"``: the members that did NOT answer could still
+        bring support to k — absence of an answer is not evidence of
+        non-durability (a partitioned quorum must not erase a
+        committed op; round-5 review finding). Callers back off.
+        ``"reapply"``: provably under-supported and nowhere followed
+        by a later mutation — re-executing the resend is safe and
+        heals the torn stripe.
+        ``"ambiguous"``: provably under-supported but later writes
+        exist in some window; re-applying would clobber them — fail
+        the resend instead of lying. The reference blocks such
+        objects as "unfound" (osd_types.h pg_missing_t;
+        PeeringState::proc_master_log rolls back what no quorum can
+        support)."""
+        support = 0
+        later = False
+        for win in windows:
+            ids = [t[0] for t in win]
+            if reqid in ids:
+                support += 1
+                if ids[-1] != reqid:
+                    later = True
+        if support >= k:
+            return "durable"
+        if support + unanswered >= k:
+            return "unknown"
+        return "ambiguous" if later else "reapply"
+
+    def _resolve_unverified_reqs(
+        self, pg: _PG, loc: str, polled=None
+    ) -> bool:
+        """Settle every storage-seeded window entry BEFORE a new op
+        stamps the window onward (round-5 review finding: stamping an
+        unverified entry into a committed op's attr replicates it to
+        all shards, laundering a torn never-acked write into a
+        'durable' one). Durable entries stay; provably-under-
+        supported ones are erased from the window and the object is
+        rolled back to its committed state so the new op builds on
+        clean bytes.
+
+        Returns False when the object's state CANNOT be settled now
+        (too few members answered to classify, an entry is ambiguous,
+        or the rollback could not establish the committed state) —
+        the caller must not mutate the object (eagain; the client's
+        backoff retries once the members answer). ``polled`` reuses a
+        fan-out the caller already paid for."""
+        win0 = self._req_window(pg, loc)  # force the storage seed
+        unv = self._req_unverified.get(loc)
+        if not unv:
+            return True
+        windows, infos = (
+            polled if polled is not None
+            else self._poll_req_state(pg, loc)
+        )
+        k = pg.rmw.sinfo.k
+        members = sum(1 for o in pg.acting if o != SHARD_NONE)
+        unanswered = max(members - len(windows), 0)
+        keep, dropped = [], []
+        for t in win0:
+            if t[0] not in unv:
+                keep.append(t)
+                continue
+            verdict = self._classify_req(windows, t[0], k, unanswered)
+            if verdict == "durable":
+                keep.append(t)
+            elif verdict == "reapply":
+                dropped.append(t[0])
+            else:
+                # unknown/ambiguous: not settleable — keep everything
+                # marked and make the caller back off rather than
+                # build on (or erase) state we cannot judge
+                return False
+        if dropped and not self._rollback_torn_object(pg, loc, infos):
+            return False  # window untouched: retry when members answer
+        self._req_windows[loc] = keep
+        self._req_unverified.pop(loc, None)
+        if dropped:
+            self.log.info(
+                "op", loc, "erased non-durable seeded reqids",
+                dropped, "- object rolled back to committed state"
+            )
+        return True
+
+    def _rollback_torn_object(
+        self, pg: _PG, loc: str, infos: list
+    ) -> bool:
+        """Roll my shard back to the committed state and report
+        success. The committed state is the max OI eversion WITNESSED
+        by >= k members — witnessing is monotone (a shard whose OI is
+        at ev' >= ev necessarily applied the commit at ev, sub-writes
+        being in tid order), so members carrying a torn later stamp
+        still vote for the committed prefix. My own (possibly torn)
+        OI witnesses too. Plain agreement-counting needed k matching
+        REMOTE OIs, unattainable for m=1 pools (round-5 review)."""
+        k = pg.rmw.sinfo.k
+        evs = [ev for _size, ev in infos]
+        my_size = 0
+        try:
+            key = self._my_key(pg, loc)
+            if key is not None:
+                my_size, my_ev = parse_oi(self.store.getattr(key, OI_KEY))
+                evs.append(tuple(my_ev))
+        except (FileNotFoundError, KeyError, ValueError):
+            pass
+        good = [
+            ev for ev in set(evs)
+            if sum(1 for e in evs if e >= ev) >= k
+        ]
+        if not good:
+            self.log.error(
+                "op", loc, "cannot roll back torn object:",
+                "no k-witnessed committed OI among reachable members"
+            )
+            return False
+        target = max(good)
+        sizes = [s for s, ev in infos if ev == target]
+        size = max(sizes) if sizes else my_size
+        pg.rmw.prime_object(loc, max(size, 0), eversion=target)
+        try:
+            my_pos = pg.acting.index(self.osd_id)
+        except ValueError:
+            return False
+        try:
+            pg.recovery.recover_object(loc, {my_pos})
+        except Exception as e:
+            self.log.error(
+                "op", loc, "torn-object rollback recovery failed:",
+                type(e).__name__, str(e),
+            )
+            return False
+        return True
 
     def _req_attr_for(self, pg: _PG, loc: str, reqid: str,
                       size: int) -> "dict[str, bytes] | None":
@@ -1717,6 +2025,15 @@ class OSDDaemon:
         replayable as a success."""
         if not reqid:
             return None
+        # settle seeded entries FIRST: stamping an unverified reqid
+        # into this op's replicated attr would spread it to every
+        # shard and launder a torn write into a "durable" one. The
+        # client-op path already settled (or eagained) before calling
+        # here — failing loudly covers any future caller that didn't.
+        if not self._resolve_unverified_reqs(pg, loc):
+            raise RuntimeError(
+                f"unsettled seeded reqid window for {loc!r}"
+            )
         win = [t for t in self._req_window(pg, loc) if t[0] != reqid]
         win.append((reqid, size))
         del win[:-REQ_WINDOW]

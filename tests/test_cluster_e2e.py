@@ -804,3 +804,230 @@ def test_resent_append_survives_primary_failover(cluster):
     op3 = OSDOp(952, mon.osdmap.epoch, "ecpool", "log", "append",
                 data=rec, reqid="clientA.10")
     assert d2._execute_client_op(op3).size == 2_600
+
+
+def test_nondurable_seeded_resend_reapplies(cluster):
+    """Round-4 advisor finding: the old primary stamped the reqid
+    window into the successor's shard txn but died before the op
+    reached k shards — the op was never acked and is NOT
+    reconstructible. The successor's seeded window must not replay it
+    as a success; a quorum poll of the replicated REQ attrs proves it
+    non-durable and the resend RE-APPLIES (at the append's original
+    offset, not the inflated size the partial apply left behind)."""
+    from ceph_tpu.cluster.osd_daemon import (
+        REQ_KEY, pack_reqs, shard_key,
+    )
+    from ceph_tpu.msg.messages import OSDOp
+    from ceph_tpu.pipeline.rmw import OI_KEY, pack_oi, parse_oi
+    from ceph_tpu.store import Transaction
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    base = payload(2_000, seed=40)
+    io.write("log", base)
+    spec = mon.osdmap.pools["ecpool"]
+
+    primary = mon.osdmap.primary("ecpool", "log")
+    d = next(dd for dd in daemons if dd.osd_id == primary)
+    d.stop()
+    mon.osd_down(primary)
+    new_primary = mon.osdmap.primary("ecpool", "log")
+    assert new_primary != primary
+    d2 = next(dd for dd in daemons if dd.osd_id == new_primary)
+
+    # fabricate the partial apply ON THE SUCCESSOR ONLY: the dead
+    # primary's sub-write stamped the reqid window + a bumped OI
+    # (size 2300) into this one shard; no other member ever saw it
+    acting = mon.osdmap.object_to_acting("ecpool", "log")
+    pos = acting.index(new_primary)
+    loc = f"{spec.pool_id}:log"
+    key = shard_key(loc, pos)
+    rec = payload(300, seed=41)
+    store = d2.store
+    _size, ev = parse_oi(store.getattr(key, OI_KEY))
+    win = store.getattr(key, REQ_KEY) if REQ_KEY in store.getattrs(
+        key
+    ) else b""
+    from ceph_tpu.cluster.osd_daemon import parse_reqs
+
+    seeded = parse_reqs(win) if win else []
+    seeded.append(("clientA.9", 2_300))
+    store.queue_transactions(
+        Transaction()
+        .setattr(key, REQ_KEY, pack_reqs(seeded))
+        .setattr(key, OI_KEY, pack_oi(2_300, (ev[0], ev[1] + 5)))
+    )
+
+    # the client's resend: without verification this replays size
+    # 2300 while every other shard holds a 2000-byte object
+    op = OSDOp(960, mon.osdmap.epoch, "ecpool", "log", "append",
+               data=rec, reqid="clientA.9")
+    r = d2._execute_client_op(op)
+    assert r.error == "", r.error
+    assert r.size == 2_300
+    # the re-apply healed the stripe everywhere: content is exact
+    assert io.stat("log") == 2_300
+    assert io.read("log") == base + rec
+
+
+def test_nondurable_resend_with_later_writes_fails(cluster):
+    """Same seeding, but the window records a LATER mutation after
+    the suspect entry — re-applying would clobber the newer write, so
+    the resend must fail loudly (the reference blocks such objects as
+    unfound) instead of acking a lost write."""
+    from ceph_tpu.cluster.osd_daemon import (
+        REQ_KEY, pack_reqs, shard_key,
+    )
+    from ceph_tpu.msg.messages import OSDOp
+    from ceph_tpu.pipeline.rmw import OI_KEY, pack_oi, parse_oi
+    from ceph_tpu.store import Transaction
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("log2", payload(2_000, seed=42))
+    spec = mon.osdmap.pools["ecpool"]
+
+    primary = mon.osdmap.primary("ecpool", "log2")
+    d = next(dd for dd in daemons if dd.osd_id == primary)
+    d.stop()
+    mon.osd_down(primary)
+    new_primary = mon.osdmap.primary("ecpool", "log2")
+    d2 = next(dd for dd in daemons if dd.osd_id == new_primary)
+
+    acting = mon.osdmap.object_to_acting("ecpool", "log2")
+    pos = acting.index(new_primary)
+    key = shard_key(f"{spec.pool_id}:log2", pos)
+    store = d2.store
+    _size, ev = parse_oi(store.getattr(key, OI_KEY))
+    store.queue_transactions(
+        Transaction()
+        .setattr(key, REQ_KEY, pack_reqs(
+            [("clientB.1", 2_300), ("clientB.2", 2_600)]
+        ))
+        .setattr(key, OI_KEY, pack_oi(2_600, (ev[0], ev[1] + 9)))
+    )
+
+    op = OSDOp(961, mon.osdmap.epoch, "ecpool", "log2", "append",
+               data=payload(300, seed=43), reqid="clientB.1")
+    r = d2._execute_client_op(op)
+    assert r.error == "eio", (r.error, r.size)
+
+
+def test_nondurable_entry_not_laundered_by_later_op(cluster):
+    """Round-5 review finding: a committed op's attr stamp used to
+    replicate the whole in-memory window — INCLUDING unverified
+    seeded entries — to every shard, laundering a torn never-acked
+    write into a 'durable' one. Seeded entries must be settled before
+    any new op stamps the window onward: the torn entry is erased,
+    the object rolls back to its committed state, the new op builds
+    on clean bytes, and the eventual resend executes as a fresh op
+    instead of replaying a lie."""
+    from ceph_tpu.cluster.osd_daemon import (
+        REQ_KEY, pack_reqs, shard_key,
+    )
+    from ceph_tpu.msg.messages import OSDOp
+    from ceph_tpu.pipeline.rmw import OI_KEY, pack_oi, parse_oi
+    from ceph_tpu.store import Transaction
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    base = payload(2_000, seed=50)
+    io.write("log3", base)
+    spec = mon.osdmap.pools["ecpool"]
+
+    primary = mon.osdmap.primary("ecpool", "log3")
+    d = next(dd for dd in daemons if dd.osd_id == primary)
+    d.stop()
+    mon.osd_down(primary)
+    new_primary = mon.osdmap.primary("ecpool", "log3")
+    d2 = next(dd for dd in daemons if dd.osd_id == new_primary)
+
+    acting = mon.osdmap.object_to_acting("ecpool", "log3")
+    pos = acting.index(new_primary)
+    key = shard_key(f"{spec.pool_id}:log3", pos)
+    store = d2.store
+    _size, ev = parse_oi(store.getattr(key, OI_KEY))
+    store.queue_transactions(
+        Transaction()
+        .setattr(key, REQ_KEY, pack_reqs([("clientC.1", 2_300)]))
+        .setattr(key, OI_KEY, pack_oi(2_300, (ev[0], ev[1] + 5)))
+    )
+
+    # ANOTHER client commits an append before the resend arrives —
+    # its attr stamp must NOT carry the unverified clientC.1 entry
+    mid = payload(100, seed=51)
+    opB = OSDOp(970, mon.osdmap.epoch, "ecpool", "log3", "append",
+                data=mid, reqid="clientD.1")
+    rB = d2._execute_client_op(opB)
+    assert rB.error == "", rB.error
+    # the torn 2300-size state was rolled back to the committed 2000
+    # before B applied, so B landed at offset 2000
+    assert rB.size == 2_100, rB.size
+    assert io.read("log3") == base + mid
+
+    # the suspect resend now finds no window entry (erased as
+    # non-durable) and executes as a FRESH append — never a replay
+    rec = payload(300, seed=52)
+    opA = OSDOp(971, mon.osdmap.epoch, "ecpool", "log3", "append",
+                data=rec, reqid="clientC.1")
+    rA = d2._execute_client_op(opA)
+    assert rA.error == "", rA.error
+    assert rA.size == 2_400, (
+        "resend must re-execute after its entry was erased, "
+        f"got size {rA.size}"
+    )
+    assert io.read("log3") == base + mid + rec
+
+
+def test_nondurable_verdict_needs_quorum_of_answers(cluster):
+    """Round-5 review finding: absence of an answer is not evidence
+    of non-durability. With most acting members unreachable, a
+    seeded resend must get EAGAIN (back off until members answer),
+    never an erase-and-reapply that could double-apply a committed
+    op."""
+    from ceph_tpu.cluster.osd_daemon import (
+        REQ_KEY, pack_reqs, shard_key,
+    )
+    from ceph_tpu.msg.messages import OSDOp
+    from ceph_tpu.pipeline.rmw import OI_KEY, pack_oi, parse_oi
+    from ceph_tpu.store import Transaction
+
+    mon, daemons, client = cluster
+    io = client.open_ioctx("ecpool")
+    io.write("log4", payload(2_000, seed=60))
+    spec = mon.osdmap.pools["ecpool"]
+
+    primary = mon.osdmap.primary("ecpool", "log4")
+    d = next(dd for dd in daemons if dd.osd_id == primary)
+    d.stop()
+    mon.osd_down(primary)
+    new_primary = mon.osdmap.primary("ecpool", "log4")
+    d2 = next(dd for dd in daemons if dd.osd_id == new_primary)
+
+    acting = mon.osdmap.object_to_acting("ecpool", "log4")
+    pos = acting.index(new_primary)
+    key = shard_key(f"{spec.pool_id}:log4", pos)
+    _size, ev = parse_oi(d2.store.getattr(key, OI_KEY))
+    d2.store.queue_transactions(
+        Transaction()
+        .setattr(key, REQ_KEY, pack_reqs([("clientE.1", 2_300)]))
+        .setattr(key, OI_KEY, pack_oi(2_300, (ev[0], ev[1] + 5)))
+    )
+    # silence two more acting members WITHOUT marking them down in
+    # the map: they remain voters the poll cannot reach
+    live = {dd.osd_id for dd in daemons} - {primary, new_primary}
+    silenced = [o for o in acting if o in live][:2]
+    assert len(silenced) == 2, (acting, live)
+    for o in silenced:
+        next(dd for dd in daemons if dd.osd_id == o).stop()
+
+    op = OSDOp(980, mon.osdmap.epoch, "ecpool", "log4", "append",
+               data=payload(300, seed=61), reqid="clientE.1")
+    r = d2._execute_client_op(op)
+    assert r.error == "eagain", (r.error, r.size)
+    # a NEW mutating op on the same object must also back off — it
+    # cannot stamp its window over an unsettled entry
+    op2 = OSDOp(981, mon.osdmap.epoch, "ecpool", "log4", "append",
+                data=payload(100, seed=62), reqid="clientF.1")
+    r2 = d2._execute_client_op(op2)
+    assert r2.error == "eagain", (r2.error, r2.size)
