@@ -30,6 +30,7 @@ from ceph_tpu import PLUGIN_ABI_VERSION
 
 from .base import ErasureCodeBase, to_int
 from .interface import ErasureCodeProfile, Flag, SubChunkPlan
+from .matrix_codec import BitplaneDispatchMixin, _dispatch_counters
 from .registry import registry
 
 
@@ -57,7 +58,7 @@ class Layer:
         self.codec = registry.factory(plugin, prof)
 
 
-class LrcCodec(ErasureCodeBase):
+class LrcCodec(BitplaneDispatchMixin, ErasureCodeBase):
     """The lrc plugin. Shard ids at the API are logical (0..k-1 data,
     k.. parity); the mapping string defines stored positions, exposed
     via get_chunk_mapping."""
@@ -84,6 +85,50 @@ class LrcCodec(ErasureCodeBase):
         p_pos = [i for i, c in enumerate(mapping) if c != "D"]
         self.chunk_mapping = d_pos + p_pos
         self._pos_to_logical = {p: i for i, p in enumerate(self.chunk_mapping)}
+        # TPU delta: encode is GF-linear through every layer, so the
+        # whole layer cascade composes into ONE [m, k] generator —
+        # a full-stripe encode is then a single shards-form kernel
+        # dispatch instead of len(layers) serialized launches (which
+        # measured 77 GB/s vs ~190 for the equivalent single matrix
+        # on the bench geometry). Byte-identical to the layered walk:
+        # local parities over globally-generated chunks substitute
+        # the global rows (L @ [D; G@D] = (L1 ^ L2*G) @ D). Decode
+        # keeps the layered walk — locality is its whole point.
+        self._composite = self._compose_generator()
+        if self._composite is not None:
+            from ceph_tpu.gf import gf_matrix_to_bitmatrix
+
+            self._comp_bmat_np = gf_matrix_to_bitmatrix(self._composite)
+            self._comp_bmat = jnp.asarray(self._comp_bmat_np)
+
+    def _compose_generator(self):
+        """[m, k] composite parity generator over the data chunks, or
+        None when a layer's inner codec exposes no byte generator."""
+        import numpy as np
+
+        from ceph_tpu.gf.matrices import gf_matmul_np
+
+        rows: dict[int, np.ndarray] = {}
+        for i in range(self.k):
+            r = np.zeros(self.k, np.uint8)
+            r[i] = 1
+            rows[self.chunk_mapping[i]] = r
+        for layer in self.layers:
+            gen = getattr(layer.codec, "generator", None)
+            if gen is None:
+                return None
+            kl = len(layer.data)
+            inmat = np.stack([
+                rows.get(p, np.zeros(self.k, np.uint8))
+                for p in layer.data
+            ])
+            coding = gf_matmul_np(np.asarray(gen)[kl:, :], inmat)
+            for j, p in enumerate(layer.coding):
+                rows[p] = coding[j]
+        parity_pos = self.chunk_mapping[self.k :]
+        if any(p not in rows for p in parity_pos):
+            return None
+        return np.stack([rows[p] for p in parity_pos])
 
     # -- profile parsing ----------------------------------------------
     def _parse_kml(self, prof: ErasureCodeProfile) -> None:
@@ -222,6 +267,35 @@ class LrcCodec(ErasureCodeBase):
 
     # -- encode --------------------------------------------------------
     def encode_chunks(
+        self, data: dict[int, jax.Array]
+    ) -> dict[int, jax.Array]:
+        if self._composite is not None:
+            return self._encode_composite(data)
+        return self._encode_layered(data)
+
+    def _encode_composite(
+        self, data: dict[int, jax.Array]
+    ) -> dict[int, jax.Array]:
+        """All layers as one matrix apply (see init)."""
+        import numpy as np
+
+        shards, xp = self._shard_list_xp(data)
+        if self._shards_host_route(shards, xp is np):
+            from ceph_tpu.gf import gf_apply_bytes_host
+
+            _dispatch_counters().inc("host_encode")
+            out = gf_apply_bytes_host(
+                self._composite, np.stack(shards, axis=-2)
+            )
+            return {
+                self.k + j: out[..., j, :] for j in range(self.m)
+            }
+        outs = self._dispatch_bitmatrix_shards(
+            self._comp_bmat_np, self._comp_bmat, shards, "encode"
+        )
+        return {self.k + j: outs[j] for j in range(self.m)}
+
+    def _encode_layered(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
         sample = next(iter(data.values()))

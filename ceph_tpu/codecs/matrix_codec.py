@@ -274,6 +274,57 @@ class BitplaneDispatchMixin:
         _dispatch_counters().inc(f"einsum_{op}")
         return _apply_bitmatrix(bmat_dev, stacked)
 
+    def _shards_host_route(self, shards: list, host_staged: bool) -> bool:
+        """One gate for every per-shard dispatch site: small host-
+        staged inputs take the host GF tables UNLESS a mesh/DCN wants
+        the shape (those routes outrank the host shortcut — see
+        _active_mesh)."""
+        if not host_staged:
+            return False
+        shape = shards[0].shape[:-1] + (
+            len(shards), shards[0].shape[-1]
+        )
+        return (
+            not self._mesh_routable_shape(shape)
+            and not self._dcn_routable_shape(shape, True)
+            and self._host_sized(*shards)
+        )
+
+    def _dispatch_bitmatrix_shards(
+        self,
+        bmat_np: np.ndarray,
+        bmat_dev: jax.Array,
+        shards: list,
+        op: str,
+    ) -> list:
+        """Per-shard-operand route: device inputs that fit the
+        shards-form Pallas kernel skip the [.., C, N] stack entirely
+        (the stack is a relayout copy that measured 3.5x the kernel's
+        own cost on the LRC/SHEC bench geometry — the same finding
+        that shaped the XOR-schedule engine's shards form,
+        ops/xor_schedule.py). DCN/mesh routes and the einsum fallback
+        still take the stacked tensor. Returns one array per output
+        row-group (R = bitmatrix rows / 8)."""
+        from ceph_tpu.ops import pallas_encode as pe
+        from ceph_tpu.utils import config
+
+        c = len(shards)
+        shape = shards[0].shape[:-1] + (c, shards[0].shape[-1])
+        host_staged = all(isinstance(v, np.ndarray) for v in shards)
+        if (
+            not host_staged
+            and config.get("ec_use_pallas")
+            and pe.on_tpu()
+            and pe.shards_supported(c, shards[0].shape)
+            and not self._mesh_routable_shape(shape)
+            and not self._dcn_routable_shape(shape, host_staged)
+        ):
+            _dispatch_counters().inc(f"pallas_{op}")
+            return pe.gf_encode_bitplane_pallas_shards(bmat_np, shards)
+        stacked = self._stack(list(shards))
+        out = self._dispatch_bitmatrix(bmat_np, bmat_dev, stacked, op)
+        return [out[..., j, :] for j in range(out.shape[-2])]
+
 
 class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
     """Codec defined by a systematic (k+m) x k GF(2^8) generator matrix."""
@@ -308,30 +359,24 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
-        stacked = self._stack_data(data)
-        parity = self._encode_stacked(stacked)
-        return {
-            self.k + i: parity[..., i, :] for i in range(self.m)
-        }
+        shards, xp = self._shard_list_xp(data)
+        parity = self._encode_shards(shards, xp)
+        return {self.k + i: parity[i] for i in range(self.m)}
 
-    def _encode_stacked(self, stacked: jax.Array) -> jax.Array:
+    def _encode_shards(self, shards: list, xp) -> list:
         """Dispatch the parity matmul: host GF tables for small numpy
-        inputs, the fused Pallas MXU kernel on TPU when the shape
-        tiles (config-gated), einsum otherwise. A mesh-routable shape
-        outranks the host shortcut (see _active_mesh)."""
-        if (
-            not self._mesh_routable(stacked)
-            and not self._dcn_routable(stacked)
-            and self._host_sized(stacked)
-        ):
+        inputs, the shards-form Pallas MXU kernel on TPU for
+        per-shard device arrays, the stacked routes otherwise."""
+        if self._shards_host_route(shards, xp is np):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_encode")
-            return gf_apply_bytes_host(
-                self.generator[self.k :, :], stacked
+            out = gf_apply_bytes_host(
+                self.generator[self.k :, :], np.stack(shards, axis=-2)
             )
-        return self._dispatch_bitmatrix(
-            self._encode_bmat_np, self._encode_bmat, stacked, "encode"
+            return [out[..., j, :] for j in range(self.m)]
+        return self._dispatch_bitmatrix_shards(
+            self._encode_bmat_np, self._encode_bmat, shards, "encode"
         )
 
     # -- decode -------------------------------------------------------
@@ -348,32 +393,27 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         if not want:
             return {w: chunks[w] for w in want_to_read}
         key = (tuple(present), tuple(want))
-        # ONE stack reused by routability checks and both routes (the
-        # old per-check restack copied all shard data 2-3x per op)
-        stacked = self._stack([chunks[i] for i in present])
-        if (
-            isinstance(stacked, np.ndarray)
-            and not self._mesh_routable(stacked)
-            and not self._dcn_routable(stacked)
-            and self._host_sized(stacked)
-        ):
+        shards = [chunks[i] for i in present]
+        host_staged = all(isinstance(v, np.ndarray) for v in shards)
+        if self._shards_host_route(shards, host_staged):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_decode")
             mat = self._host_tables.get(
                 key, lambda: self._build_decode_bytes(present, want)
             )
-            out = gf_apply_bytes_host(mat, stacked)
+            out = gf_apply_bytes_host(mat, np.stack(shards, axis=-2))
+            outs = [out[..., j, :] for j in range(len(want))]
         else:
             bmat_np, bmat_dev = self._tables.get(
                 key, lambda: self._build_decode_bmat(present, want)
             )
-            out = self._dispatch_bitmatrix(
-                bmat_np, bmat_dev, stacked, "decode"
+            outs = self._dispatch_bitmatrix_shards(
+                bmat_np, bmat_dev, shards, "decode"
             )
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, w in enumerate(want):
-            result[w] = out[..., idx, :]
+            result[w] = outs[idx]
         return result
 
     def _build_decode_bytes(
@@ -418,18 +458,15 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         one small matmul over just the changed columns.
         """
         cols = sorted(delta)
-        stacked = self._stack([delta[c] for c in cols])  # one copy
-        if (
-            isinstance(stacked, np.ndarray)
-            and not self._mesh_routable(stacked)
-            and not self._dcn_routable(stacked)
-            and self._host_sized(stacked)
-        ):
+        shards = [delta[c] for c in cols]
+        host_staged = all(isinstance(v, np.ndarray) for v in shards)
+        if self._shards_host_route(shards, host_staged):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc("host_delta")
             contrib = gf_apply_bytes_host(
-                self.generator[self.k :, cols], stacked
+                self.generator[self.k :, cols],
+                np.stack(shards, axis=-2),
             )
             return {
                 pid: np.bitwise_xor(
@@ -445,10 +482,10 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         bmat_np, bmat_dev = self._tables.get(
             ("delta", tuple(cols)), _build_delta
         )
-        contrib = self._dispatch_bitmatrix(
-            bmat_np, bmat_dev, stacked, "delta"
+        contribs = self._dispatch_bitmatrix_shards(
+            bmat_np, bmat_dev, shards, "delta"
         )
         return {
-            pid: xor_bytes(p, contrib[..., pid - self.k, :])
+            pid: xor_bytes(p, contribs[pid - self.k])
             for pid, p in parity.items()
         }

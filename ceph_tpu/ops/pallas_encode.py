@@ -287,6 +287,181 @@ def supported(data_shape: tuple[int, ...]) -> bool:
     return len(data_shape) == 3 and data_shape[-1] % LANE_TILE == 0
 
 
+# ----------------------------------------------------------- shards form
+#: block rows per grid step (sublane granularity: a 2D block's
+#: second-minor dim must be a multiple of 8 or the whole axis)
+SHARDS_SB = 8
+#: shards-form lane-tile cap: 64 KiB tiles crashed the remote Mosaic
+#: compiler at c=8 and measured no better than 32 KiB where they
+#: compiled (experiments/exp_r5_byteshards2.py)
+SHARDS_MAX_TILE = 32768
+
+
+def _v4_matrix(
+    bitmatrix: np.ndarray, c: int, r: int, s: int, pad: int
+) -> np.ndarray:
+    """Stationary matrix for the shards-form kernel: v3's row order
+    with SHARD-MAJOR bit columns, so a group's flat input is a concat
+    of contiguous per-shard [s, T] slices.
+
+    acc row  = h*(4*s*r) + si*(4*r) + j*4 + b2   (output bit b' = h*4+b2)
+    bits col = b*F + i*s + si, F = s*c + pad     (pad columns stay zero)
+    """
+    f = s * c + pad
+    mat = np.zeros((8 * s * r, 8 * f), np.int8)
+    for h in range(2):
+        for si in range(s):
+            for j in range(r):
+                for b2 in range(4):
+                    bp = h * 4 + b2
+                    row = h * (4 * s * r) + si * (4 * r) + j * 4 + b2
+                    for b in range(8):
+                        for i in range(c):
+                            mat[row, b * f + i * s + si] = bitmatrix[
+                                j * 8 + bp, i * 8 + b
+                            ]
+    return mat
+
+
+def _shards_stripes(c: int) -> int | None:
+    """Stripes per matmul group: largest s with contraction 8*s*c
+    <= 128 — the F=16 sweet spot the stacked-path sweep found, now
+    per-shard (c=2 -> s=8 measured 284 GB/s vs 85 stacked; c=4 ->
+    s=4, 147 vs 27 through the stacked codec path). c > 8 has no
+    viable s and stays on the stacked kernel."""
+    for s in (8, 4, 2):
+        if s * c <= 16:
+            return s
+    return None
+
+
+def shards_supported(c: int, shape: tuple[int, ...]) -> bool:
+    """Can the shards-form kernel serve c per-shard [..., N] arrays?"""
+    if len(shape) < 1 or _shards_stripes(c) is None:
+        return False
+    n = shape[-1]
+    b = int(np.prod(shape[:-1], initial=1))
+    return b % SHARDS_SB == 0 and n % LANE_TILE == 0
+
+
+def _shards_tile(n: int) -> int:
+    t = min(SHARDS_MAX_TILE, n)
+    while t > LANE_TILE and n % t:
+        t -= LANE_TILE
+    return t
+
+
+@functools.lru_cache(maxsize=128)
+def _shards_fn(
+    mat_bytes: bytes, r8: int, c8: int, s: int, tile: int,
+    interpret: bool,
+):
+    """Jitted shards-form apply, cached per (bitmatrix, geometry).
+
+    The kernel carries SB stripes of every shard per block and loops
+    over SB/s groups; each group is one stationary matmul with the
+    SHARD-MAJOR v4 matrix (bits col = b*F + i*s + si), so the group's
+    flat input is a concat of contiguous [s, T] slices — no per-row
+    sublane gathers. Output rows come back in (si, j) order and land
+    in m separate parity refs: neither input nor output is ever
+    stacked in HBM, which is the whole win (the [B, k, N] stack is a
+    relayout copy measured at 3.5x the kernel's own cost on the
+    SHEC/LRC bench geometry)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bitmatrix = np.frombuffer(mat_bytes, np.uint8).reshape(r8, c8)
+    c, r = c8 // 8, r8 // 8
+    pad = (-s * c) % 4
+    groups = SHARDS_SB // s
+    big = _v4_matrix(bitmatrix, c, r, s, pad)
+
+    def kernel(bmat_ref, *refs):
+        ins, outs = refs[:c], refs[c:]
+        t = ins[0].shape[1]
+        for g in range(groups):
+            parts = [ins[i][g * s : (g + 1) * s, :] for i in range(c)]
+            flat = jnp.concatenate(parts, axis=0)  # [s*c, T] (i, si)
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
+                )
+            bits = unpack_bitplanes(flat, interpret)
+            acc = jax.lax.dot_general(
+                bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            acc8 = acc.astype(jnp.int8)
+            if interpret:
+                p32 = _emulate_i8_to_i32(acc8)
+            else:
+                p32 = pltpu.bitcast(acc8, jnp.int32)
+            masked = p32 & jnp.int32(0x01010101)
+            nib = (
+                masked | (masked >> jnp.int32(7))
+                | (masked >> jnp.int32(14)) | (masked >> jnp.int32(21))
+            ) & jnp.int32(0xF)
+            sr = s * r
+            out32 = nib[0:sr] | (nib[sr : 2 * sr] << jnp.int32(4))
+            out8 = out32.astype(jnp.uint8).reshape(s, r, t)
+            for j in range(r):
+                outs[j][g * s : (g + 1) * s, :] = out8[:, j, :]
+
+    @jax.jit
+    def apply(bmat, *shards):
+        b, n = shards[0].shape
+        return pl.pallas_call(
+            kernel,
+            grid=(b // SHARDS_SB, n // tile),
+            in_specs=[pl.BlockSpec(big.shape, lambda i, ch: (0, 0))]
+            + [
+                pl.BlockSpec((SHARDS_SB, tile), lambda i, ch: (i, ch))
+                for _ in range(c)
+            ],
+            out_specs=[
+                pl.BlockSpec((SHARDS_SB, tile), lambda i, ch: (i, ch))
+                for _ in range(r)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, n), jnp.uint8)
+                for _ in range(r)
+            ],
+            interpret=interpret,
+        )(bmat, *shards)
+
+    return apply, big
+
+
+def gf_encode_bitplane_pallas_shards(
+    bitmatrix,
+    shards: list,
+    interpret: bool | None = None,
+) -> list:
+    """Shards-form bitmatrix apply: c per-shard [..., N] arrays in,
+    R = rows/8 per-shard parity arrays out — same math as
+    ``gf_encode_bitplane_pallas`` with neither side ever stacked.
+    Callers gate with ``shards_supported``."""
+    if interpret is None:
+        interpret = not on_tpu()
+    mat = np.ascontiguousarray(np.asarray(bitmatrix, dtype=np.uint8))
+    r8, c8 = mat.shape
+    lead = shards[0].shape[:-1]
+    n = shards[0].shape[-1]
+    if c8 != len(shards) * 8:
+        raise ValueError(
+            f"bitmatrix cols {c8} != shards*8 {len(shards) * 8}"
+        )
+    s = _shards_stripes(c8 // 8)
+    key = (mat.tobytes(), r8, c8, s, _shards_tile(n), interpret)
+    fn, big = _shards_fn(*key)
+    traced = any(isinstance(v, jax.core.Tracer) for v in shards)
+    if not traced:
+        big = _v3_dev_cached(("v4",) + key[:-1], big)
+    b = int(np.prod(lead, initial=1))
+    flat = [jnp.asarray(v).reshape(b, n) for v in shards]
+    outs = fn(big, *flat)
+    return [o.reshape(lead + (n,)) for o in outs]
+
+
 def on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"

@@ -1,0 +1,77 @@
+"""Round-5: shards-form MXU kernel sweep — stripes-per-block (s) and
+geometry. Follows exp_r5_multiop_byte.py; adds the s sweep (F = s*c up
+to 32 — exp_highk measured the column stream fastest at F=32) and the
+SHEC/LRC bench geometry ([256, 64 KiB] shards, c=4) where the stacked
+path pays a 3.5x relayout (prof: raw 132 / stacked 38 / codec 27).
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
+from ceph_tpu.ops import pallas_encode as pe
+from experiments.exp_r5_multiop_byte import (
+    build_loop_shards,
+    build_loop_stacked,
+    dev_rand,
+    loop_stats,
+    make_multiop_byte,
+)
+
+
+def sweep(k, m, batch, chunk, tiles, ss):
+    g = vandermonde_rs_matrix(k, m)
+    bmat = gf_matrix_to_bitmatrix(g[k:, :])
+    nbytes = batch * k * chunk
+
+    data = dev_rand((batch, k, chunk), 0)
+    loop = build_loop_stacked(lambda d: pe.gf_encode_bitplane_pallas(bmat, d))
+    per = loop_stats(loop, data)
+    print(f"  stacked v3 auto: {nbytes/per/1e9:.1f} GB/s", flush=True)
+
+    small = tuple(dev_rand((8, 8192), 10 + i) for i in range(k))
+    stacked_small = jnp.stack(small, axis=1)
+    want = pe.gf_encode_bitplane_pallas(bmat, stacked_small)
+    shards = tuple(dev_rand((batch, chunk), 20 + i) for i in range(k))
+    for s in ss:
+        if batch % s:
+            continue
+        ap = make_multiop_byte(bmat, k, m, s, 8192)
+        outs = ap(*small)
+        ok = all(
+            np.array_equal(np.asarray(outs[j]), np.asarray(want[:, j, :]))
+            for j in range(m)
+        )
+        for tile in tiles:
+            if chunk % tile:
+                continue
+            try:
+                ap = make_multiop_byte(bmat, k, m, s, tile)
+                loop = build_loop_shards(ap)
+                per = loop_stats(loop, shards)
+                print(
+                    f"  multiop s={s} F={s*k} tile={tile}: "
+                    f"{nbytes/per/1e9:.1f} GB/s ok={ok}",
+                    flush=True,
+                )
+            except Exception as e:
+                print(f"  multiop s={s} tile={tile}: {type(e).__name__} "
+                      f"{str(e)[:80]}", flush=True)
+
+
+def main():
+    print("flagship (8,4) batch=8 chunk=1M:", flush=True)
+    sweep(8, 4, 8, 1 << 20, (32768, 65536), (2, 4, 8))
+    print("shec-geom (4,3) batch=256 chunk=64K:", flush=True)
+    sweep(4, 3, 256, 65536, (16384, 32768, 65536), (2, 4, 8, 16))
+    print("lrc-local (2,1) batch=256 chunk=64K:", flush=True)
+    sweep(2, 1, 256, 65536, (32768, 65536), (2, 4, 8, 16))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Round-5: shards-form MXU kernel, take 2.
+
+Blocks must be sublane-aligned (last-two block dims divisible by
+(8, 128) — the take-1 (2, tile) block refused to lower), so the block
+carries SB=8 stripes of every shard and the kernel loops over
+SB/s groups of s stripes, each group one stationary matmul with
+contraction 8*(s*c).
+
+The stationary matrix is SHARD-MAJOR (col = b*F + i*s + si) so each
+group's flat input is a concat of contiguous [s, T] slices of the
+shard refs — no per-row sublane gathers.
+"""
+
+import functools
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
+from ceph_tpu.ops import pallas_encode as pe
+from ceph_tpu.ops.pallas_encode import unpack_bitplanes
+from experiments.exp_r5_multiop_byte import (
+    build_loop_shards,
+    build_loop_stacked,
+    dev_rand,
+    loop_stats,
+)
+
+SB = 8
+
+
+def _v4_matrix(bitmatrix, c, r, s, pad):
+    """Stationary matrix, shard-major columns.
+
+    acc row  = h*(4*s*r) + si*(4*r) + j*4 + b2   (same as v3)
+    bits col = b*F + i*s + si, F = s*c + pad
+    """
+    f = s * c + pad
+    mat = np.zeros((8 * s * r, 8 * f), np.int8)
+    for h in range(2):
+        for si in range(s):
+            for j in range(r):
+                for b2 in range(4):
+                    bp = h * 4 + b2
+                    row = h * (4 * s * r) + si * (4 * r) + j * 4 + b2
+                    for b in range(8):
+                        for i in range(c):
+                            mat[row, b * f + i * s + si] = bitmatrix[
+                                j * 8 + bp, i * 8 + b
+                            ]
+    return mat
+
+
+def make_shards_kernel(bitmatrix, k, m, s, tile):
+    from jax.experimental.pallas import tpu as pltpu
+
+    c = k
+    pad = (-s * c) % 4
+    groups = SB // s
+    big = _v4_matrix(np.asarray(bitmatrix, np.uint8), c, m, s, pad)
+
+    def kernel(bmat_ref, *refs):
+        ins, outs = refs[:k], refs[k:]
+        t = ins[0].shape[1]
+        for g in range(groups):
+            parts = [ins[i][g * s : (g + 1) * s, :] for i in range(c)]
+            flat = jnp.concatenate(parts, axis=0)  # [s*c, T] (i, si)
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
+                )
+            bits = unpack_bitplanes(flat, False)
+            acc = jax.lax.dot_general(
+                bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            acc8 = acc.astype(jnp.int8)
+            p32 = pltpu.bitcast(acc8, jnp.int32)
+            masked = p32 & jnp.int32(0x01010101)
+            nib = (
+                masked | (masked >> jnp.int32(7))
+                | (masked >> jnp.int32(14)) | (masked >> jnp.int32(21))
+            ) & jnp.int32(0xF)
+            sr = s * m
+            out32 = nib[0:sr] | (nib[sr : 2 * sr] << jnp.int32(4))
+            out8 = out32.astype(jnp.uint8).reshape(s, m, t)
+            for j in range(m):
+                outs[j][g * s : (g + 1) * s, :] = out8[:, j, :]
+
+    @jax.jit
+    def apply(*shards):
+        b, n = shards[0].shape
+        return pl.pallas_call(
+            kernel,
+            grid=(b // SB, n // tile),
+            in_specs=[pl.BlockSpec(big.shape, lambda i, c2: (0, 0))]
+            + [
+                pl.BlockSpec((SB, tile), lambda i, c2: (i, c2))
+                for _ in range(k)
+            ],
+            out_specs=[
+                pl.BlockSpec((SB, tile), lambda i, c2: (i, c2))
+                for _ in range(m)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, n), jnp.uint8)
+                for _ in range(m)
+            ],
+        )(big, *shards)
+
+    return apply
+
+
+def sweep(k, m, batch, chunk, tiles, ss):
+    g = vandermonde_rs_matrix(k, m)
+    bmat = gf_matrix_to_bitmatrix(g[k:, :])
+    nbytes = batch * k * chunk
+
+    data = dev_rand((batch, k, chunk), 0)
+    loop = build_loop_stacked(lambda d: pe.gf_encode_bitplane_pallas(bmat, d))
+    per = loop_stats(loop, data)
+    print(f"  stacked v3 auto: {nbytes/per/1e9:.1f} GB/s", flush=True)
+
+    small = tuple(dev_rand((8, 8192), 10 + i) for i in range(k))
+    stacked_small = jnp.stack(small, axis=1)
+    want = pe.gf_encode_bitplane_pallas(bmat, stacked_small)
+    shards = tuple(dev_rand((batch, chunk), 20 + i) for i in range(k))
+    for s in ss:
+        try:
+            ap = make_shards_kernel(bmat, k, m, s, 8192)
+            outs = ap(*small)
+            ok = all(
+                np.array_equal(np.asarray(outs[j]), np.asarray(want[:, j, :]))
+                for j in range(m)
+            )
+        except Exception as e:
+            print(f"  shards s={s}: build fail {type(e).__name__} "
+                  f"{str(e)[:90]}", flush=True)
+            continue
+        for tile in tiles:
+            if chunk % tile:
+                continue
+            try:
+                ap = make_shards_kernel(bmat, k, m, s, tile)
+                loop = build_loop_shards(ap)
+                per = loop_stats(loop, shards)
+                print(
+                    f"  shards s={s} F={s*k} tile={tile}: "
+                    f"{nbytes/per/1e9:.1f} GB/s ok={ok}",
+                    flush=True,
+                )
+            except Exception as e:
+                print(f"  shards s={s} tile={tile}: {type(e).__name__} "
+                      f"{str(e)[:90]}", flush=True)
+
+
+def main():
+    print("flagship (8,4) batch=8 chunk=1M:", flush=True)
+    sweep(8, 4, 8, 1 << 20, (16384, 32768, 65536), (2, 4, 8))
+    print("shec-geom (4,3) batch=256 chunk=64K:", flush=True)
+    sweep(4, 3, 256, 65536, (16384, 32768, 65536), (2, 4, 8))
+    print("lrc-local (2,1) batch=256 chunk=64K:", flush=True)
+    sweep(2, 1, 256, 65536, (32768, 65536), (2, 4, 8))
+
+
+if __name__ == "__main__":
+    main()
