@@ -36,6 +36,7 @@ from .matrix_codec import (
     BitplaneDispatchMixin,
     DecodeTableCache,
     _dispatch_counters,
+    dev_bmat,
 )
 
 
@@ -329,9 +330,25 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
             _dispatch_counters().inc(f"sched_{op}")
             out = xor_schedule.xor_schedule_apply(rows, packets)
         else:
-            bm_np, bm_dev = tables or self._device_tables(mat01)
+            if tables:
+                bm_np, bm_dev = tables
+            else:
+                bm_np, key = self._host_bits(mat01)
+                bm_dev = dev_bmat(
+                    self._tables, key, bm_np,
+                    isinstance(packets, jax.core.Tracer),
+                )
             out = self._dispatch_bitmatrix(bm_np, bm_dev, packets, op)
         return self._to_chunks(out)
+
+    def _host_bits(self, mat01: np.ndarray):
+        """(bit-expanded HOST matrix, cache key) for a packet 0/1
+        matrix — the one source of truth for the ("bits", ...) cache
+        (shared with the DCN worker's host-side decode)."""
+        key = ("bits", mat01.tobytes())
+        return self._tables.get(
+            key, lambda: gf_matrix_to_bitmatrix(mat01)
+        ), key
 
     def _try_sched_shards(
         self, mat01: np.ndarray, shards: list, op: str
@@ -383,13 +400,6 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
             return rows if ok else None
 
         return self._sched_tables.get(key, build)
-
-    def _device_tables(self, mat01: np.ndarray):
-        def build():
-            bm = gf_matrix_to_bitmatrix(mat01)
-            return bm, jnp.asarray(bm)
-
-        return self._tables.get(("bits", mat01.tobytes()), build)
 
     def encode_chunks(
         self, data: dict[int, jax.Array]
@@ -529,5 +539,6 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
                 for b, r in enumerate(chosen):
                     dec[wi * self.w + a, col_of[r]] = comp[a, b]
         # host 0/1 matrix — cached in _host_tables and consumed by
-        # both routes (the device route bit-expands via _device_tables)
+        # both routes (the device route bit-expands via _host_bits +
+        # dev_bmat)
         return dec

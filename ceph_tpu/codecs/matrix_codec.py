@@ -78,6 +78,21 @@ def _dispatch_counters():
     return b.create_perf_counters()
 
 
+def dev_bmat(
+    cache: "DecodeTableCache", key: tuple, np_mat: np.ndarray,
+    traced: bool,
+) -> jax.Array:
+    """Device copy of a host matrix. Under a trace the copy is a
+    TRACE-LOCAL constant — caching an array created while tracing
+    stores that trace's tracer and poisons every later call with the
+    same key (UnexpectedTracerError; the round-3 lru_cache lesson,
+    re-hit by the traced CLAY repair's inner decode). Eager callers
+    get an LRU-cached concrete upload."""
+    if traced:
+        return jnp.asarray(np_mat)
+    return cache.get(("dev",) + key, lambda: jnp.asarray(np_mat))
+
+
 class DecodeTableCache:
     """LRU of device bit-matrices keyed by (present-shards, wanted-shards).
 
@@ -405,11 +420,16 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
             out = gf_apply_bytes_host(mat, np.stack(shards, axis=-2))
             outs = [out[..., j, :] for j in range(len(want))]
         else:
-            bmat_np, bmat_dev = self._tables.get(
+            bmat_np = self._tables.get(
                 key, lambda: self._build_decode_bmat(present, want)
             )
+            traced = any(
+                isinstance(v, jax.core.Tracer) for v in shards
+            )
             outs = self._dispatch_bitmatrix_shards(
-                bmat_np, bmat_dev, shards, "decode"
+                bmat_np,
+                dev_bmat(self._tables, key, bmat_np, traced),
+                shards, "decode",
             )
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, w in enumerate(want):
@@ -437,9 +457,12 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
 
     def _build_decode_bmat(
         self, present: list[int], want: list[int]
-    ) -> tuple[np.ndarray, jax.Array]:
-        bm = gf_matrix_to_bitmatrix(self._build_decode_bytes(present, want))
-        return bm, jnp.asarray(bm)
+    ) -> np.ndarray:
+        """HOST bitmatrix only — the device copy goes through
+        dev_bmat so a trace never caches its own tracer."""
+        return gf_matrix_to_bitmatrix(
+            self._build_decode_bytes(present, want)
+        )
 
     # -- parity delta (RMW) -------------------------------------------
     def encode_delta(
@@ -475,15 +498,16 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
                 for pid, p in parity.items()
             }
 
-        def _build_delta():
-            bm = gf_matrix_to_bitmatrix(self.generator[self.k :, cols])
-            return bm, jnp.asarray(bm)
-
-        bmat_np, bmat_dev = self._tables.get(
-            ("delta", tuple(cols)), _build_delta
+        key = ("delta", tuple(cols))
+        bmat_np = self._tables.get(
+            key,
+            lambda: gf_matrix_to_bitmatrix(self.generator[self.k :, cols]),
         )
+        traced = any(isinstance(v, jax.core.Tracer) for v in shards)
         contribs = self._dispatch_bitmatrix_shards(
-            bmat_np, bmat_dev, shards, "delta"
+            bmat_np,
+            dev_bmat(self._tables, key, bmat_np, traced),
+            shards, "delta",
         )
         return {
             pid: xor_bytes(p, contribs[pid - self.k])

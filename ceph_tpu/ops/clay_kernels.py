@@ -1,0 +1,269 @@
+"""Pallas kernels for the CLAY aloof-free fast repair path.
+
+The XLA formulation of the repair stages (stack rows -> plane-permute
+gather -> fused pair transform; pair-combine -> stack -> inverse
+permute) pays every intermediate against HBM: ~500 MB of traffic to
+repair 45 MB of helper bytes. These kernels express the SAME algebra
+as in-VMEM lane-slice networks — each plane is a contiguous ``sc``-lane
+block of a shard row, so the pair transform and the final plane
+scatter are static slice arithmetic inside one grid step, and HBM sees
+each byte once in and once out.
+
+Pair algebra (fixed by the construction's RS(2,2) coupling matrix,
+codecs/clay.py): U = C ^ 2*(C_hi ^ C_lo) both ways, and its inverse
+C_lost = C ^ inv2*(C ^ U). GF mul/div-by-2 run on int32 lanes holding
+4 packed bytes (Mosaic cannot shift i8 vectors): shift, then mask the
+cross-byte leak, then fold the reduction polynomial per byte. The
+caller verifies the codec's coefficients match before routing here
+(falls back to the XLA path otherwise).
+
+Matches repair_one_lost_chunk (ErasureCodeClay.cc:454-699) restricted
+to aloof == {}.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .pallas_encode import _emulate_i32_to_i8, _emulate_i8_to_i32
+
+SB = 8  # stripes per block (sublane granularity; 4 bytes/i32 lane x 2)
+
+
+def _mul2_i32(xi):
+    """Per-byte GF(2^8)/0x11D multiply-by-2 on packed int32 lanes."""
+    return (
+        ((xi & jnp.int32(0x7F7F7F7F)) << jnp.int32(1))
+        ^ (((xi >> jnp.int32(7)) & jnp.int32(0x01010101))
+           * jnp.int32(0x1D))
+    )
+
+
+def _div2_i32(xi):
+    """Per-byte multiply by inv(2) = 142 on packed int32 lanes."""
+    return (
+        ((xi >> jnp.int32(1)) & jnp.int32(0x7F7F7F7F))
+        ^ ((xi & jnp.int32(0x01010101)) * jnp.int32(0x8E))
+    )
+
+
+def _u8_to_i32(x, interpret):
+    if interpret:
+        return _emulate_i8_to_i32(x)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.bitcast(x, jnp.int32)
+
+
+def _i32_to_u8(p, interpret):
+    if interpret:
+        return _emulate_i32_to_i8(p).astype(jnp.uint8)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.bitcast(p, jnp.int8).astype(jnp.uint8)
+
+
+def supported(b: int, sc: int) -> bool:
+    """Batch must block on sublanes; plane packets must lane-align."""
+    return b % SB == 0 and sc % 128 == 0
+
+
+@functools.lru_cache(maxsize=64)
+def _uncoupled_fn(
+    rows: tuple[int, ...],
+    q: int,
+    pvec_y: tuple[tuple[int, ...], ...],
+    swap_p: tuple[tuple[tuple[int, ...], ...], ...],
+    sc: int,
+    interpret: bool,
+):
+    """Stage-a kernel: (t-1)*q helper refs [B, P*sc] in, ONE stacked
+    uncoupled tensor [B, (t-1)*q, P*sc] out (the exact input form the
+    inner-MDS stacked matmul wants).
+
+    ``pvec_y[ri][p]`` is plane p's digit for row rows[ri];
+    ``swap_p[ri][x][p]`` the companion plane index for node x."""
+    n_in = len(rows) * q
+    P = len(pvec_y[0])
+
+    # Greedy run merge: consecutive planes with the same digit class
+    # and contiguous companions collapse into one wide slice op (the
+    # minor free digit gives q-long runs — 4x fewer vector ops).
+    plans: list[list[tuple[int, int, int, int]]] = []
+    for ri in range(len(rows)):
+        for x in range(q):
+            runs = []
+            p = 0
+            while p < P:
+                zv = pvec_y[ri][p]
+                pp = swap_p[ri][x][p]
+                end = p + 1
+                while (
+                    end < P
+                    and pvec_y[ri][end] == zv
+                    and swap_p[ri][x][end] == pp + (end - p)
+                ):
+                    end += 1
+                runs.append((p, end, zv, pp))
+                p = end
+            plans.append(runs)
+
+    def kernel(*refs):
+        ins, out = refs[:n_in], refs[n_in]
+        xi = [_u8_to_i32(r[:], interpret) for r in ins]
+        for ri in range(len(rows)):
+            for x in range(q):
+                a32 = xi[ri * q + x]
+                for p0, p1, zv, pp in plans[ri * q + x]:
+                    a = a32[:, p0 * sc : p1 * sc]
+                    if zv == x:
+                        u = a
+                    else:
+                        b = xi[ri * q + zv][
+                            :, pp * sc : (pp + p1 - p0) * sc
+                        ]
+                        u = a ^ _mul2_i32(a ^ b)
+                    out[:, ri * q + x, p0 * sc : p1 * sc] = (
+                        _i32_to_u8(u, interpret)
+                    )
+
+    @jax.jit
+    def apply(*helpers):
+        b = helpers[0].shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(b // SB,),
+            in_specs=[
+                pl.BlockSpec((SB, P * sc), lambda i: (i, 0))
+                for _ in range(n_in)
+            ],
+            out_specs=pl.BlockSpec(
+                (SB, n_in, P * sc), lambda i: (i, 0, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (b, n_in, P * sc), jnp.uint8
+            ),
+            interpret=interpret,
+        )(*helpers)
+
+    return apply
+
+
+@functools.lru_cache(maxsize=64)
+def _couple_scatter_fn(
+    q: int,
+    x_l: int,
+    dst_p: tuple[tuple[int, ...], ...],
+    P: int,
+    sc: int,
+    sub_chunk_no: int,
+    interpret: bool,
+):
+    """Stage-c kernel: q-1 lost-row helper refs [B, P*sc] plus the
+    decoded lost-row U [B, q, P*sc] in, the recovered full chunk
+    [B, sub_chunk_no*sc] out. ``dst_p[x][p]`` is the absolute plane
+    each (row member x, repair plane p) pair produces."""
+
+    # Merge contiguous destination planes (get_repair_subchunks hands
+    # back runs, so the scatter is long contiguous lane stores).
+    runs_x: list[list[tuple[int, int, int]]] = []
+    for x in range(q):
+        runs = []
+        p = 0
+        while p < P:
+            z = dst_p[x][p]
+            end = p + 1
+            while end < P and dst_p[x][end] == z + (end - p):
+                end += 1
+            runs.append((p, end, z))
+            p = end
+        runs_x.append(runs)
+
+    def kernel(*refs):
+        helpers, udec, out = refs[: q - 1], refs[q - 1], refs[q]
+        hi = 0
+        for x in range(q):
+            u32 = _u8_to_i32(udec[:, x, :], interpret)
+            if x == x_l:
+                for p0, p1, z in runs_x[x]:
+                    out[:, z * sc : (z + p1 - p0) * sc] = _i32_to_u8(
+                        u32[:, p0 * sc : p1 * sc], interpret
+                    )
+                continue
+            h32 = _u8_to_i32(helpers[hi][:], interpret)
+            hi += 1
+            for p0, p1, z in runs_x[x]:
+                a = h32[:, p0 * sc : p1 * sc]
+                b = u32[:, p0 * sc : p1 * sc]
+                out[:, z * sc : (z + p1 - p0) * sc] = _i32_to_u8(
+                    a ^ _div2_i32(a ^ b), interpret
+                )
+
+    @jax.jit
+    def apply(udec, *helpers):
+        b = udec.shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(b // SB,),
+            in_specs=[
+                pl.BlockSpec((SB, P * sc), lambda i: (i, 0))
+                for _ in range(q - 1)
+            ]
+            + [pl.BlockSpec((SB, q, P * sc), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec(
+                (SB, sub_chunk_no * sc), lambda i: (i, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (b, sub_chunk_no * sc), jnp.uint8
+            ),
+            interpret=interpret,
+        )(*helpers, udec)
+
+    return apply
+
+
+def uncoupled_rows(
+    rows: list[int],
+    q: int,
+    pvec_y: list[list[int]],
+    swap_p,
+    helpers: list,
+    sc: int,
+    interpret: bool = False,
+):
+    """helpers: (t-1)*q arrays [B, P*sc] (row-major, x within row).
+    Returns the stacked uncoupled tensor [B, (t-1)*q, P*sc]."""
+    fn = _uncoupled_fn(
+        tuple(rows), q,
+        tuple(tuple(v) for v in pvec_y),
+        tuple(tuple(tuple(xs) for xs in r) for r in swap_p),
+        sc, interpret,
+    )
+    return fn(*helpers)
+
+
+def couple_scatter(
+    q: int,
+    x_l: int,
+    dst_p,
+    udec,
+    helpers: list,
+    sc: int,
+    sub_chunk_no: int,
+    interpret: bool = False,
+):
+    """udec: [B, q, P*sc] decoded lost-row U; helpers: q-1 lost-row
+    helper arrays [B, P*sc] (ascending x, lost member absent).
+    Returns the recovered chunk [B, sub_chunk_no*sc]."""
+    P = len(dst_p[0])
+    fn = _couple_scatter_fn(
+        q, x_l,
+        tuple(tuple(v) for v in dst_p),
+        P, sc, sub_chunk_no, interpret,
+    )
+    return fn(udec, *helpers)

@@ -164,9 +164,9 @@ def _worker_main(argv: list[str]) -> None:
                     key,
                     lambda: codec._build_decode_bitmatrix(present, want),
                 )
-                bm_np = codec._device_tables(dec01)[0]
+                bm_np, _key = codec._host_bits(dec01)
             else:
-                bm_np, _ = codec._tables.get(
+                bm_np = codec._tables.get(
                     key, lambda: codec._build_decode_bmat(present, want)
                 )
         else:
