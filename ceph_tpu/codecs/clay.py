@@ -920,7 +920,7 @@ class ClayCodec(ErasureCodeBase):
         b = int(_np.prod(lead, initial=1))
         if (
             self.scalar_mds not in ("jerasure", "isa")
-            or not clay_kernels.supported(b, sc)
+            or not clay_kernels.supported(b, sc, self.sub_chunk_no)
             or not self._canonical_pair_algebra()
         ):
             return None

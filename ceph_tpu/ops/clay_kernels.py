@@ -32,7 +32,22 @@ from jax.experimental import pallas as pl
 
 from .pallas_encode import _emulate_i32_to_i8, _emulate_i8_to_i32
 
-SB = 8  # stripes per block (sublane granularity; 4 bytes/i32 lane x 2)
+SB = 8   # minimum stripes per block (sublane granularity)
+#: scatter-block lane budget: sb * sub_chunk_no * sc (the kernel's
+#: VMEM footprint scales with the FULL chunk, not one plane packet).
+#: Measured on v5e: 1 Mi lanes (SB=16, sub=64, sc=1024) compiles with
+#: headroom; 2 Mi OOMs scoped VMEM.
+MAX_SCATTER_LANES = 1 << 20
+
+
+def _pick_sb(b: int, row_lanes: int, budget: int) -> int:
+    """Largest block row count that divides the batch and keeps the
+    block (sb * row_lanes output lanes) within the measured VMEM
+    budget: 16 measured ~1 GB/s over 8 (fewer DMA grid steps)."""
+    for sb in (16, 8):
+        if b % sb == 0 and sb * row_lanes <= budget:
+            return sb
+    return SB
 
 
 def _mul2_i32(xi):
@@ -68,9 +83,15 @@ def _i32_to_u8(p, interpret):
     return pltpu.bitcast(p, jnp.int8).astype(jnp.uint8)
 
 
-def supported(b: int, sc: int) -> bool:
-    """Batch must block on sublanes; plane packets must lane-align."""
-    return b % SB == 0 and sc % 128 == 0
+def supported(b: int, sc: int, sub_chunk_no: int) -> bool:
+    """Batch must block on sublanes; plane packets must lane-align
+    and the FULL-CHUNK scatter block must fit the VMEM budget (bigger
+    sub-chunk counts or packets fall back to the XLA fast path)."""
+    return (
+        b % SB == 0
+        and sc % 128 == 0
+        and SB * sub_chunk_no * sc <= MAX_SCATTER_LANES
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -80,6 +101,7 @@ def _uncoupled_fn(
     pvec_y: tuple[tuple[int, ...], ...],
     swap_p: tuple[tuple[tuple[int, ...], ...], ...],
     sc: int,
+    sb: int,
     interpret: bool,
 ):
     """Stage-a kernel: (t-1)*q helper refs [B, P*sc] in, ONE stacked
@@ -137,13 +159,13 @@ def _uncoupled_fn(
         b = helpers[0].shape[0]
         return pl.pallas_call(
             kernel,
-            grid=(b // SB,),
+            grid=(b // sb,),
             in_specs=[
-                pl.BlockSpec((SB, P * sc), lambda i: (i, 0))
+                pl.BlockSpec((sb, P * sc), lambda i: (i, 0))
                 for _ in range(n_in)
             ],
             out_specs=pl.BlockSpec(
-                (SB, n_in, P * sc), lambda i: (i, 0, 0)
+                (sb, n_in, P * sc), lambda i: (i, 0, 0)
             ),
             out_shape=jax.ShapeDtypeStruct(
                 (b, n_in, P * sc), jnp.uint8
@@ -162,6 +184,7 @@ def _couple_scatter_fn(
     P: int,
     sc: int,
     sub_chunk_no: int,
+    sb: int,
     interpret: bool,
 ):
     """Stage-c kernel: q-1 lost-row helper refs [B, P*sc] plus the
@@ -209,14 +232,14 @@ def _couple_scatter_fn(
         b = udec.shape[0]
         return pl.pallas_call(
             kernel,
-            grid=(b // SB,),
+            grid=(b // sb,),
             in_specs=[
-                pl.BlockSpec((SB, P * sc), lambda i: (i, 0))
+                pl.BlockSpec((sb, P * sc), lambda i: (i, 0))
                 for _ in range(q - 1)
             ]
-            + [pl.BlockSpec((SB, q, P * sc), lambda i: (i, 0, 0))],
+            + [pl.BlockSpec((sb, q, P * sc), lambda i: (i, 0, 0))],
             out_specs=pl.BlockSpec(
-                (SB, sub_chunk_no * sc), lambda i: (i, 0)
+                (sb, sub_chunk_no * sc), lambda i: (i, 0)
             ),
             out_shape=jax.ShapeDtypeStruct(
                 (b, sub_chunk_no * sc), jnp.uint8
@@ -242,7 +265,13 @@ def uncoupled_rows(
         tuple(rows), q,
         tuple(tuple(v) for v in pvec_y),
         tuple(tuple(tuple(xs) for xs in r) for r in swap_p),
-        sc, interpret,
+        sc,
+        _pick_sb(
+            helpers[0].shape[0],
+            len(helpers) * len(pvec_y[0]) * sc,
+            2 * MAX_SCATTER_LANES,
+        ),
+        interpret,
     )
     return fn(*helpers)
 
@@ -264,6 +293,10 @@ def couple_scatter(
     fn = _couple_scatter_fn(
         q, x_l,
         tuple(tuple(v) for v in dst_p),
-        P, sc, sub_chunk_no, interpret,
+        P, sc, sub_chunk_no,
+        _pick_sb(
+            udec.shape[0], sub_chunk_no * sc, MAX_SCATTER_LANES
+        ),
+        interpret,
     )
     return fn(udec, *helpers)
