@@ -357,7 +357,11 @@ class ClayCodec(ErasureCodeBase):
     def encode_chunks(
         self, data: dict[int, jax.Array]
     ) -> dict[int, jax.Array]:
-        sample = np.asarray(next(iter(data.values())))
+        # encode = decode with all parity erased; see _is_traced for
+        # the traced/host split rationale
+        traced = self._is_traced(data.values())
+        xp = jax.numpy if traced else np
+        sample = xp.asarray(next(iter(data.values())))
         nbytes = sample.shape[-1]
         if nbytes % self.sub_chunk_no:
             raise ValueError(
@@ -369,24 +373,28 @@ class ClayCodec(ErasureCodeBase):
         shape = sample.shape[:-1] + (self.sub_chunk_no, sc)
         C = {}
         for i in range(self.k):
-            arr = np.asarray(data.get(i)) if i in data else None
+            arr = xp.asarray(data[i]) if i in data else None
             C[i] = (
-                np.zeros(shape, np.uint8)
+                xp.zeros(shape, np.uint8)
                 if arr is None
-                else arr.reshape(shape).astype(np.uint8).copy()
+                else self._reshaped(arr, shape, xp)
             )
-        for i in range(self.k, self.k + self.nu):
-            C[i] = np.zeros(shape, np.uint8)
-        for i in range(self.k + self.nu, n):
-            C[i] = np.zeros(shape, np.uint8)
+        for i in range(self.k, n):
+            C[i] = xp.zeros(shape, np.uint8)
         erased = set(range(self.k + self.nu, n))
-        self._decode_layered(erased, C)
+        self._decode_layered(erased, C, traced)
         return {
             self.k + j: jax.numpy.asarray(
                 C[self.k + self.nu + j].reshape(sample.shape[:-1] + (nbytes,))
             )
             for j in range(self.m)
         }
+
+    @staticmethod
+    def _reshaped(arr, shape, xp):
+        # astype always copies (even same-dtype), so the host path's
+        # in-place mutation never aliases caller data
+        return arr.reshape(shape).astype(np.uint8)
 
     # -- full decode ---------------------------------------------------
     def decode_chunks(
@@ -401,7 +409,9 @@ class ClayCodec(ErasureCodeBase):
             raise ValueError(
                 f"cannot decode: {len(chunks)} < k={self.k} chunks"
             )
-        sample = np.asarray(next(iter(chunks.values())))
+        traced = self._is_traced(chunks.values())
+        xp = jax.numpy if traced else np
+        sample = xp.asarray(next(iter(chunks.values())))
         nbytes = sample.shape[-1]
         if nbytes % self.sub_chunk_no:
             raise ValueError(
@@ -416,18 +426,15 @@ class ClayCodec(ErasureCodeBase):
         for chunk_id in range(self.k + self.m):
             node = self._to_node(chunk_id)
             if chunk_id in chunks:
-                C[node] = (
-                    np.asarray(chunks[chunk_id])
-                    .reshape(shape)
-                    .astype(np.uint8)
-                    .copy()
+                C[node] = self._reshaped(
+                    xp.asarray(chunks[chunk_id]), shape, xp
                 )
             else:
-                C[node] = np.zeros(shape, np.uint8)
+                C[node] = xp.zeros(shape, np.uint8)
                 erased.add(node)
         for i in range(self.k, self.k + self.nu):
-            C[i] = np.zeros(shape, np.uint8)
-        self._decode_layered(erased, C)
+            C[i] = xp.zeros(shape, np.uint8)
+        self._decode_layered(erased, C, traced)
         out = {s: chunks[s] for s in want_to_read if s in chunks}
         for s in missing:
             out[s] = jax.numpy.asarray(
@@ -436,11 +443,36 @@ class ClayCodec(ErasureCodeBase):
         return out
 
     # -- the layered engine -------------------------------------------
+    @staticmethod
+    def _is_traced(values) -> bool:
+        """True when any input is a jax tracer: the engines then
+        build ONE functional device program (jit over a fixed erasure
+        pattern). Eager callers keep the host path — an un-jitted run
+        of the traced body would be hundreds of per-op device round
+        trips."""
+        return any(isinstance(v, jax.core.Tracer) for v in values)
+
+    @staticmethod
+    def _setz(arr, z: int, val, traced: bool):
+        """arr[..., z, :] = val — in place (host) or functional."""
+        if traced:
+            return arr.at[..., z, :].set(val)
+        arr[..., z, :] = val
+        return arr
+
     def _decode_layered(
-        self, erased_chunks: set[int], C: dict[int, np.ndarray]
+        self,
+        erased_chunks: set[int],
+        C: dict[int, np.ndarray],
+        traced: bool = False,
     ) -> None:
         """Recover coupled values of ``erased_chunks`` (node ids) in
-        place (decode_layered, ErasureCodeClay.cc:702-767)."""
+        ``C`` (decode_layered, ErasureCodeClay.cc:702-767). TRACE-
+        GENERIC like repair: host numpy mutates in place; tracer
+        inputs build one functional device program (jit over a fixed
+        erasure pattern), which is what makes CLAY encode AND full
+        decode usable on device — encode is decode with all parity
+        erased."""
         q, t, n = self.q, self.t, self.q * self.t
         erased = set(erased_chunks)
         for i in range(self.k + self.nu, n):
@@ -452,7 +484,12 @@ class ClayCodec(ErasureCodeBase):
                 f"too many erasures {sorted(erased_chunks)} for m={self.m}"
             )
         shape = next(iter(C.values())).shape
-        U = {i: np.zeros(shape, np.uint8) for i in range(n)}
+        if traced:
+            import jax.numpy as jnp
+
+            U = {i: jnp.zeros(shape, np.uint8) for i in range(n)}
+        else:
+            U = {i: np.zeros(shape, np.uint8) for i in range(n)}
 
         # order[z] = number of erased nodes that are dots in plane z.
         order: dict[int, list[int]] = {}
@@ -467,10 +504,10 @@ class ClayCodec(ErasureCodeBase):
             # plane (pair reads touch companion planes of other groups,
             # already final).
             for z in planes:
-                self._compute_uncoupled(erased, z, C, U)
+                self._compute_uncoupled(erased, z, C, U, traced)
             # Step b: ONE batched inner-MDS decode across this score
             # group (TPU delta: the reference dispatches per plane).
-            self._decode_uncoupled_batch(erased, planes, U)
+            self._decode_uncoupled_batch(erased, planes, U, traced)
             # Step c: uncoupled -> coupled for erased nodes.
             for z in planes:
                 z_vec = self._plane_vector(z)
@@ -479,31 +516,37 @@ class ClayCodec(ErasureCodeBase):
                     node_sw = y * q + z_vec[y]
                     z_sw = self._z_sw(z, x, y, z_vec)
                     if z_vec[y] == x:  # dot: C = U
-                        C[node][..., z, :] = U[node][..., z, :]
+                        C[node] = self._setz(
+                            C[node], z, U[node][..., z, :], traced
+                        )
                     elif node_sw not in erased:
                         # recover_type1: C_xy from (C_sw, U_xy).
                         ci, ui = self._pair_idx(x, z_vec[y])
                         cj, _ = self._pair_idx(z_vec[y], x)
-                        C[node][..., z, :] = self._pair_solve(
-                            (cj, ui),
-                            C[node_sw][..., z_sw, :],
-                            U[node][..., z, :],
-                            ci,
+                        C[node] = self._setz(
+                            C[node], z,
+                            self._pair_solve(
+                                (cj, ui),
+                                C[node_sw][..., z_sw, :],
+                                U[node][..., z, :],
+                                ci,
+                            ),
+                            traced,
                         )
                     elif z_vec[y] < x:
                         # Both pair members erased: invert the full
                         # pair transform from (U_xy, U_sw).
-                        C[node][..., z, :] = self._pair_solve(
-                            (2, 3),
-                            U[node][..., z, :],
-                            U[node_sw][..., z_sw, :],
-                            0,
+                        u_xy = U[node][..., z, :]
+                        u_sw = U[node_sw][..., z_sw, :]
+                        C[node] = self._setz(
+                            C[node], z,
+                            self._pair_solve((2, 3), u_xy, u_sw, 0),
+                            traced,
                         )
-                        C[node_sw][..., z_sw, :] = self._pair_solve(
-                            (2, 3),
-                            U[node][..., z, :],
-                            U[node_sw][..., z_sw, :],
-                            1,
+                        C[node_sw] = self._setz(
+                            C[node_sw], z_sw,
+                            self._pair_solve((2, 3), u_xy, u_sw, 1),
+                            traced,
                         )
 
     def _compute_uncoupled(
@@ -512,6 +555,7 @@ class ClayCodec(ErasureCodeBase):
         z: int,
         C: dict[int, np.ndarray],
         U: dict[int, np.ndarray],
+        traced: bool = False,
     ) -> None:
         """U values of non-erased nodes in plane z (decode_erasures,
         ErasureCodeClay.cc:769-796)."""
@@ -525,7 +569,9 @@ class ClayCodec(ErasureCodeBase):
                 node_sw = q * y + z_vec[y]
                 z_sw = self._z_sw(z, x, y, z_vec)
                 if z_vec[y] == x:
-                    U[node][..., z, :] = C[node][..., z, :]
+                    U[node] = self._setz(
+                        U[node], z, C[node][..., z, :], traced
+                    )
                 elif z_vec[y] < x or node_sw in erased:
                     # Forward transform of the coupled pair fills the
                     # U of both members.
@@ -533,11 +579,15 @@ class ClayCodec(ErasureCodeBase):
                     sw_c, sw_u = self._pair_idx(z_vec[y], x)
                     a = C[node][..., z, :]
                     b = C[node_sw][..., z_sw, :]
-                    U[node][..., z, :] = self._pair_solve(
-                        (node_c, sw_c), a, b, node_u
+                    U[node] = self._setz(
+                        U[node], z,
+                        self._pair_solve((node_c, sw_c), a, b, node_u),
+                        traced,
                     )
-                    U[node_sw][..., z_sw, :] = self._pair_solve(
-                        (node_c, sw_c), a, b, sw_u
+                    U[node_sw] = self._setz(
+                        U[node_sw], z_sw,
+                        self._pair_solve((node_c, sw_c), a, b, sw_u),
+                        traced,
                     )
 
     def _decode_uncoupled_batch(
@@ -545,6 +595,7 @@ class ClayCodec(ErasureCodeBase):
         erased: set[int],
         planes: list[int],
         U: dict[int, np.ndarray],
+        traced: bool = False,
     ) -> None:
         """Inner-MDS decode of erased nodes' U over a batch of planes
         in one device dispatch (decode_uncoupled,
@@ -560,7 +611,10 @@ class ClayCodec(ErasureCodeBase):
         }
         out = self.mds.decode_chunks(set(erased), known)
         for node in erased:
-            U[node][..., zsel, :] = np.asarray(out[node])
+            if traced:
+                U[node] = U[node].at[..., zsel, :].set(out[node])
+            else:
+                U[node][..., zsel, :] = np.asarray(out[node])
 
     # -- fractional repair ---------------------------------------------
     def repair(
@@ -611,11 +665,7 @@ class ClayCodec(ErasureCodeBase):
             chunks = {i: np.asarray(v) for i, v in chunks.items()}
 
         def setz(arr, z, val):
-            """arr[..., z, :] = val, in-place (host) or functional."""
-            if traced:
-                return arr.at[..., z, :].set(val)
-            arr[..., z, :] = val
-            return arr
+            return self._setz(arr, z, val, traced)
 
         repair_planes: list[int] = []
         for index, count in self.get_repair_subchunks(lost_node):
