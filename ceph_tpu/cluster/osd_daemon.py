@@ -408,6 +408,16 @@ class OSDDaemon:
         self.op_timeout = op_timeout
         self.local = ShardBackend(_AnyShardStores(self.store))
         self.peers = NetShardBackend({}, secret=secret)
+        # stamp my map interval into every sub-write (replica fence)
+        self.peers.interval_fn = lambda: (
+            self.osdmap.epoch, self.osd_id
+        )
+        #: (pool_id, pgid) -> newest interval epoch whose ELECTION has
+        #: queried me (or that I activated): answering a peering query
+        #: fences this member against sub-writes from older intervals
+        #: of that PG — the same_interval_since discard rule
+        #: (osd/PeeringState.h; OSD::require_same_or_newer_map)
+        self._fence_epochs: dict[tuple[int, int], int] = {}
         self.osdmap: OSDMap = monitor.osdmap
         self.messenger = Messenger(f"osd.{osd_id}", secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
@@ -462,6 +472,8 @@ class OSDDaemon:
         #: the client (round-4 advisor finding). Entries leave the set
         #: once a quorum poll proves >= k shards recorded them.
         self._req_unverified: dict[str, set] = {}
+        #: loc -> monotonic time of its last durability fan-out
+        self._req_poll_at: dict[str, float] = {}
         self._completed_cap = 1024
         self._stopped = False
         # -- background scrub scheduling (osd/scrubber/osd_scrub.cc):
@@ -974,6 +986,38 @@ class OSDDaemon:
                 out.append((loc, si))
         return out
 
+    def _sub_write_interval_ok(self, msg, loc: str) -> bool:
+        """Replica-side interval fence for sub-writes: once a NEWER
+        interval's election has queried (or activated) this member for
+        the object's PG, sub-writes stamped with an older map epoch
+        are rejected — they come from a superseded primary whose
+        commit would be invisible to the authority the election chose
+        (same_interval_since discard; OSD::require_same_or_newer_map).
+        Unfenced messages (standalone pipeline tiers) pass."""
+        if msg.from_osd < 0 or not msg.epoch:
+            return True
+        try:
+            from ceph_tpu.placement import stable_hash
+
+            pool_id, oid = split_loc(loc)
+            for spec in self.osdmap.pools.values():
+                if spec.pool_id == pool_id:
+                    pgid = stable_hash(
+                        str(pool_id), head_of_loc(oid)
+                    ) % spec.pg_num
+                    fence = self._fence_epochs.get((pool_id, pgid), 0)
+                    if msg.epoch < fence:
+                        self.log.info(
+                            "fence: sub-write from osd.", msg.from_osd,
+                            f"e{msg.epoch} rejected:", loc,
+                            f"interval e{fence} already peered here",
+                        )
+                        return False
+                    return True
+        except Exception:
+            pass  # unparseable loc etc.: do not wedge the data path
+        return True
+
     def _my_key(self, pg: _PG, oid: str) -> str | None:
         """My shard key for this object, from my acting position."""
         try:
@@ -1220,11 +1264,25 @@ class OSDDaemon:
                 lu = tuple(ev)
         return self._pgmeta_read(pool_id, pgid), lu
 
+    def _bump_fence(self, pool_id: int, pgid: int, epoch: int) -> None:
+        key = (pool_id, pgid)
+        if epoch > self._fence_epochs.get(key, 0):
+            self._fence_epochs[key] = epoch
+
     def _handle_pg_info(self, conn: Connection, msg: PGInfo) -> None:
+        # FENCE FIRST: once this member answers an interval-E
+        # election, a superseded primary's older-interval sub-writes
+        # must not commit through it — otherwise a write can land
+        # AFTER the election read this member's log and be invisible
+        # to the new authority (the round-5 kill/revive thrash lost a
+        # committed append to exactly that interleaving).
+        if msg.epoch:
+            self._bump_fence(msg.pool_id, msg.pgid, msg.epoch)
         les, lu = self._own_pg_info(msg.pool_id, msg.pg_num, msg.pgid)
         conn.send(PGInfoReply(msg.tid, msg.shard, les, lu[0], lu[1]))
 
     def _handle_pg_activate(self, conn: Connection, msg: PGActivate) -> None:
+        self._bump_fence(msg.pool_id, msg.pgid, msg.epoch)
         self._pgmeta_write_les(msg.pool_id, msg.pgid, msg.epoch)
         conn.send(PGActivateAck(msg.tid, msg.shard))
 
@@ -1236,6 +1294,16 @@ class OSDDaemon:
         election saw the OLD interval, and letting it open the gate
         for the new one would serve exactly the unpeered window this
         machinery exists to prevent (round-5 review finding)."""
+        # The election may rewind/recover objects underneath the
+        # in-memory reqid-window cache: a revived ex-primary that
+        # seeded windows from its STALE store before losing the
+        # election kept judging (and replaying!) from them after
+        # recovery rewrote the attrs — the round-5 kill/revive thrash
+        # lost a committed append to exactly that. Ops are gated until
+        # peering completes, so dropping the cache here makes the
+        # first post-peering op re-seed from the post-rewind store.
+        self._req_windows.clear()
+        self._req_unverified.clear()
         with self._peer_lock:
             pg.peered.clear()
             if pg._peering:
@@ -1258,6 +1326,10 @@ class OSDDaemon:
                     continue  # a newer interval arrived mid-election
                 pg._peering = False
                 if done:
+                    # serve the NEW interval from the store, not from
+                    # the last primacy's in-memory projections (see
+                    # RMWPipeline.on_interval_change)
+                    pg.rmw.on_interval_change()
                     pg.peered.set()
                 return
 
@@ -1301,13 +1373,20 @@ class OSDDaemon:
                     # admitted (clean by construction).
                     continue
                 if osd == self.osd_id:
+                    # fence myself first: my own replica role must
+                    # reject older-interval sub-writes from here on
+                    self._bump_fence(spec.pool_id, pg.pgid, epoch0)
                     infos[osd] = self._own_pg_info(
                         spec.pool_id, spec.pg_num, pg.pgid
                     )
                     continue
                 try:
+                    # the query carries epoch0: answering FENCES the
+                    # member against older-interval sub-writes, so
+                    # nothing can commit behind this election's back
                     infos[osd] = self.peers.get_pg_info(
-                        osd, spec.pool_id, spec.pg_num, pg.pgid
+                        osd, spec.pool_id, spec.pg_num, pg.pgid,
+                        epoch=epoch0,
                     )
                 except Exception:
                     continue  # down members don't vote
@@ -1452,6 +1531,19 @@ class OSDDaemon:
         elif isinstance(msg, ECSubWrite):
             oids = msg.txn.oids()
             loc = split_shard_key(oids[0])[0] if oids else ""
+            if not self._sub_write_interval_ok(msg, loc):
+                # interval fence (OSD::require_same_or_newer_map /
+                # the MOSDECSubOpWrite map_epoch check): a superseded
+                # primary whose map lags behind mine must not commit
+                # through me — without this, a revived ex-primary
+                # served an append from its stale state and tore the
+                # log the REAL primary was appending to (round-5
+                # kill/revive thrash find). Rejected: the stale op
+                # never acks, its client resends against a fresh map.
+                conn.send(
+                    ECSubWriteReply(msg.tid, msg.shard, committed=False)
+                )
+                return
             from ceph_tpu.pipeline.inject import ec_inject
 
             if ec_inject.test_write_error3(loc):
@@ -1646,6 +1738,17 @@ class OSDDaemon:
                 if hit is not None:
                     unv = self._req_unverified.get(msg.oid)
                     if unv and msg.reqid in unv:
+                        import time as _time
+
+                        now = _time.monotonic()
+                        if (
+                            now - self._req_poll_at.get(msg.oid, 0.0)
+                            < self.REQ_POLL_COOLDOWN
+                        ):
+                            return OSDOpReply(
+                                msg.tid, epoch, error="eagain"
+                            )
+                        self._req_poll_at[msg.oid] = now
                         polled = self._poll_req_state(pg0, msg.oid)
                         members = sum(
                             1 for o in pg0.acting if o != SHARD_NONE
@@ -1807,6 +1910,9 @@ class OSDDaemon:
     #: path, but it runs under _op_lock — a full RPC timeout per
     #: member would stall every client op on the daemon)
     REQ_POLL_TIMEOUT = 2.5
+    #: minimum spacing between fan-outs for the SAME unsettled object
+    #: (client retries answer eagain from the cooldown, not a re-poll)
+    REQ_POLL_COOLDOWN = 1.0
 
     def _poll_req_state(self, pg: _PG, loc: str):
         """ONE async fan-out to the acting members for the object's
@@ -1822,6 +1928,15 @@ class OSDDaemon:
         pending = 0
         for si, osd in enumerate(pg.acting):
             if osd == SHARD_NONE or osd == self.osd_id:
+                continue
+            if si in pg.backend.recovering:
+                # a RETURNED member mid-log-replay is behind: its
+                # window/OI reflect the state from before it died, so
+                # its "I have no record of that op" is not evidence —
+                # counting it erased a committed append in the
+                # kill/revive thrash (round-5 chaos find). It stays
+                # un-answered (-> "unknown"/eagain) until the replay
+                # admits it; then its vote counts.
                 continue
             key = shard_key(loc, si)
             if self.peers.get_attrs_async(
@@ -1934,6 +2049,17 @@ class OSDDaemon:
         unv = self._req_unverified.get(loc)
         if not unv:
             return True
+        # throttle: an unsettled object re-polled on every client
+        # retry held _op_lock for the full fan-out deadline each time
+        # and starved heartbeats under churn — within the cooldown,
+        # answer eagain from the last verdict instead of re-polling
+        import time as _time
+
+        now = _time.monotonic()
+        last = self._req_poll_at.get(loc, 0.0)
+        if polled is None and now - last < self.REQ_POLL_COOLDOWN:
+            return False
+        self._req_poll_at[loc] = now
         windows, infos = (
             polled if polled is not None
             else self._poll_req_state(pg, loc)

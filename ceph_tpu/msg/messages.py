@@ -64,27 +64,39 @@ def _parse(seg: bytes, kind: str) -> dict:
 
 @dataclass
 class ECSubWrite:
-    """Per-shard write sub-op (ECSubWrite, osd/ECMsgTypes.h)."""
+    """Per-shard write sub-op (ECSubWrite, osd/ECMsgTypes.h).
+
+    ``epoch``/``from_osd`` carry the sender's map interval for the
+    replica-side fence (the MOSDECSubOpWrite map_epoch role): a
+    superseded primary whose map lags must not commit through
+    replicas that already serve a newer interval — the replica
+    rejects, the stale op never acks, and the client's resend lands
+    on the real primary (OSD::require_same_or_newer_map)."""
 
     tid: int
     shard: int
     txn: Transaction
     trace_id: str | None = None
     parent_span: str | None = None
+    epoch: int = 0
+    from_osd: int = -1
 
     def encode(self) -> list[bytes]:
         h = {"tid": self.tid, "shard": self.shard}
         if self.trace_id is not None:  # keep untraced wire bytes lean
             h["trace"] = [self.trace_id, self.parent_span]
+        if self.epoch:
+            h["e"] = [self.epoch, self.from_osd]
         return [_header("sub_write", h), self.txn.to_bytes()]
 
     @classmethod
     def decode(cls, segments: list[bytes]) -> "ECSubWrite":
         h = _parse(segments[0], "sub_write")
         trace = h.get("trace") or [None, None]
+        e = h.get("e") or [0, -1]
         return cls(
             h["tid"], h["shard"], Transaction.from_bytes(segments[1]),
-            trace[0], trace[1],
+            trace[0], trace[1], e[0], e[1],
         )
 
 
@@ -399,6 +411,10 @@ class PGInfo:
     pool_id: int
     pg_num: int
     pgid: int
+    #: the querying election's map epoch: answering FENCES the member
+    #: against sub-writes from older intervals of this PG (the
+    #: MOSDPGQuery epoch role) -- see OSDDaemon._sub_write_interval_ok
+    epoch: int = 0
 
     def encode(self) -> list[bytes]:
         return [
@@ -410,6 +426,7 @@ class PGInfo:
                     "pool_id": self.pool_id,
                     "pg_num": self.pg_num,
                     "pgid": self.pgid,
+                    "epoch": self.epoch,
                 },
             )
         ]
@@ -417,7 +434,10 @@ class PGInfo:
     @classmethod
     def decode(cls, segments: list[bytes]) -> "PGInfo":
         h = _parse(segments[0], "pg_info")
-        return cls(h["tid"], h["shard"], h["pool_id"], h["pg_num"], h["pgid"])
+        return cls(
+            h["tid"], h["shard"], h["pool_id"], h["pg_num"], h["pgid"],
+            h.get("epoch", 0),
+        )
 
 
 @dataclass

@@ -381,18 +381,21 @@ class NetShardBackend:
         return result.oids
 
     def get_pg_info(
-        self, shard: int, pool_id: int, pg_num: int, pgid: int
+        self, shard: int, pool_id: int, pg_num: int, pgid: int,
+        epoch: int = 0,
     ) -> tuple[int, tuple[int, int]]:
         """Synchronous peering info fetch: the peer's
         (last_epoch_started, last_update) for one PG, answered from
-        its durable store (proc_replica_info's data source)."""
+        its durable store (proc_replica_info's data source).
+        ``epoch`` fences the answering member against sub-writes from
+        older intervals of this PG before it answers."""
         tid = next(self._tids)
         out: dict[str, object] = {}
         self._register(
             tid, shard, "", lambda r: out.update(r=r), is_read=True
         )
         if not self._send(
-            shard, PGInfo(tid, shard, pool_id, pg_num, pgid), tid
+            shard, PGInfo(tid, shard, pool_id, pg_num, pgid, epoch), tid
         ):
             raise ConnectionError(f"osd.{shard} unreachable for pg info")
         self.drain_until(lambda: "r" in out, timeout=self.timeout + 5)
@@ -495,6 +498,11 @@ class NetShardBackend:
             raise FileNotFoundError(oid)
         return result.attrs
 
+    #: set by the owning OSD daemon: () -> (map_epoch, osd_id), the
+    #: sender interval stamped into every sub-write for the replica
+    #: fence (standalone pipeline tests leave it None: no fencing)
+    interval_fn = None
+
     def submit_shard_txn(
         self, shard: int, txn: Transaction, ack: Callable[[], None]
     ) -> None:
@@ -507,9 +515,15 @@ class NetShardBackend:
 
         self._register(tid, shard, "", on_reply, is_read=False)
         t_id, t_span = tracer.current()
+        epoch, from_osd = (
+            self.interval_fn() if self.interval_fn else (0, -1)
+        )
         self._send(
             shard,
-            ECSubWrite(tid, shard, txn, trace_id=t_id, parent_span=t_span),
+            ECSubWrite(
+                tid, shard, txn, trace_id=t_id, parent_span=t_span,
+                epoch=epoch, from_osd=from_osd,
+            ),
             tid,
         )
 

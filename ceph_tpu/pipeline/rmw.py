@@ -402,6 +402,26 @@ class RMWPipeline:
             .create_perf_counters()
         )
 
+    def on_interval_change(self) -> None:
+        """Drop every in-memory projection of object state (sizes,
+        eversions, hinfo, cached extents) — PG::on_change. While this
+        daemon was NOT the serving primary, its STORE advanced through
+        the replica sub-write role, which never updates these caches:
+        a re-elected ex-primary serving from them computed append
+        offsets from its last primacy's sizes and tore the log the
+        interim primary had extended (round-5 kill/revive thrash
+        find). The next op re-primes from the store's OI/HashInfo
+        attrs. Old-interval in-flight ops cannot re-poison the maps:
+        their sub-writes are interval-fenced at the members, so they
+        park and never reach the commit bookkeeping."""
+        with self._ack_lock:
+            self._object_sizes.clear()
+            self._projected_sizes.clear()
+            self._eversions.clear()
+            self._live_eversions.clear()
+            self._hinfo.clear()
+            self.cache.on_change()
+
     # -- client entry (ECBackend::submit_transaction analog) -----------
     def submit(
         self,

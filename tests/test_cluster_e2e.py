@@ -800,10 +800,18 @@ def test_resent_append_survives_primary_failover(cluster):
     assert r2.size == 2_300, "resent append re-applied after failover"
     assert io.stat("log") == 2_300
     assert io.read("log") == base + rec
-    # a genuinely NEW append still lands
-    op3 = OSDOp(952, mon.osdmap.epoch, "ecpool", "log", "append",
-                data=rec, reqid="clientA.10")
-    assert d2._execute_client_op(op3).size == 2_600
+    # a genuinely NEW append still lands (retry through the
+    # durability-poll cooldown the way the objecter's backoff would)
+    import time as _t
+
+    for _ in range(40):
+        op3 = OSDOp(952, mon.osdmap.epoch, "ecpool", "log", "append",
+                    data=rec, reqid="clientA.10")
+        r3 = d2._execute_client_op(op3)
+        if r3.error != "eagain":
+            break
+        _t.sleep(0.1)
+    assert r3.error == "" and r3.size == 2_600, (r3.error, r3.size)
 
 
 def test_nondurable_seeded_resend_reapplies(cluster):
