@@ -309,17 +309,25 @@ def _measure_device_path(result: dict, roofline: float) -> float:
     result["hbm_gbps"] = round(hbm_gbps, 1)
     result["hbm_roofline_frac"] = round(hbm_gbps / roofline, 3)
     # The flagship kernel is COMPUTE-bound, not HBM-bound: the
-    # bit-plane formulation streams (8*s*R) x (8*s*K) int8 matmuls
-    # whose MAC count per data byte is (8sR * 8sK) / (sK) = 512 for
-    # (8,4) at s=2 (the block-diagonal stripe pair doubles rows AND
-    # contraction, so half the MACs are structural zeros the MXU
-    # still clocks). Report the achieved MXU rate against the v5e
-    # public int8 peak (394.7 TOPS) — ~0.7 there with hbm_frac ~0.33
-    # is the roofline story for this op, not an unexplained gap.
-    macs_per_byte = (8 * 2 * M) * (8 * 2 * K) / (2 * K)
-    mxu_tops = 2 * macs_per_byte * enc_gbps / 1e3  # TOPS
+    # bit-plane formulation streams [8R, 8F] int8 matmuls (F = K +
+    # pad-to-4). MAC accounting comes from the kernel's own packing
+    # rule (ops.pallas_encode.mac_stats): 256 MACs per data byte at
+    # (8,4) — HALF the round-5 count, whose s=2 block-diagonal stripe
+    # pair clocked 512 with every other MAC a structural zero.
+    # mxu_util_frac is the achieved rate against the v5e public int8
+    # peak (394.7 TOPS); mxu_useful_util_frac discounts the pad
+    # columns — the only structural zeros the zero-waste layout has
+    # left (identical to mxu_util_frac for the flagship, where
+    # K % 4 == 0 means no pad at all).
+    from ceph_tpu.ops.pallas_encode import mac_stats
+
+    stats = mac_stats(K, M)
+    mxu_tops = 2 * stats["macs_per_byte"] * enc_gbps / 1e3  # TOPS
     result["mxu_tops"] = round(mxu_tops, 1)
     result["mxu_util_frac"] = round(mxu_tops / 394.7, 3)
+    result["mxu_useful_util_frac"] = round(
+        mxu_tops * stats["useful_frac"] / 394.7, 3
+    )
     return enc_gbps
 
 

@@ -474,6 +474,19 @@ class OSDDaemon:
         self._req_unverified: dict[str, set] = {}
         #: loc -> monotonic time of its last durability fan-out
         self._req_poll_at: dict[str, float] = {}
+        #: queued reqid-cache invalidations from _kick_peering /
+        #: pool deletion, applied under _op_lock by the next client
+        #: op (_drain_req_flushes). _kick_peering cannot take
+        #: _op_lock itself: it runs under _pg_lock, and the op path
+        #: nests _op_lock -> _pg_lock (via _get_pg), so the reverse
+        #: order would deadlock — the round-5 unlocked clear() raced
+        #: in-flight ops instead, letting a mid-op window re-insert
+        #: survive the rewind. Entries: ("pg", pool_id, pg_num, pgid)
+        #: | ("pool", pool_id) | None (= flush everything). Guarded
+        #: by _req_flush_lock, a leaf lock never held across another
+        #: acquire.
+        self._req_flush: set = set()
+        self._req_flush_lock = threading.Lock()
         self._completed_cap = 1024
         self._stopped = False
         # -- background scrub scheduling (osd/scrubber/osd_scrub.cc):
@@ -640,10 +653,31 @@ class OSDDaemon:
             # are) — and deletions accumulate so a skipped epoch or a
             # straggler write can't leak keys forever
             live_ids = {s.pool_id for s in osdmap.pools.values()}
+            dead_ids = set()
             for spec in self.osdmap.pools.values():
                 if spec.pool_id not in live_ids:
                     self._doomed_pool_ids.add(spec.pool_id)
                     self._gc_clean_streak = 0
+                    dead_ids.add(spec.pool_id)
+            if dead_ids:
+                # a deleted pool's soft state is garbage its id will
+                # never reclaim: prune the interval fences and queue a
+                # reqid-cache flush for its objects, or a long-lived
+                # daemon grows per-(pool, pg) / per-object entries
+                # without bound across create/delete churn. Prune by
+                # the DOOMED set, not by absence from live_ids: a
+                # fence can legitimately precede this member's
+                # knowledge of its pool (peering messages from a
+                # newer map), and must survive until that pool is
+                # provably deleted.
+                doomed_now = dead_ids | self._doomed_pool_ids
+                for key in [
+                    k for k in self._fence_epochs if k[0] in doomed_now
+                ]:
+                    del self._fence_epochs[key]
+                with self._req_flush_lock:
+                    for pid in dead_ids:
+                        self._req_flush.add(("pool", pid))
             self.osdmap = osdmap
             for osd, info in osdmap.osds.items():
                 if osd == self.osd_id:
@@ -1300,10 +1334,24 @@ class OSDDaemon:
         # election kept judging (and replaying!) from them after
         # recovery rewrote the attrs — the round-5 kill/revive thrash
         # lost a committed append to exactly that. Ops are gated until
-        # peering completes, so dropping the cache here makes the
-        # first post-peering op re-seed from the post-rewind store.
-        self._req_windows.clear()
-        self._req_unverified.clear()
+        # peering completes, so invalidating here makes the first
+        # post-peering op re-seed from the post-rewind store. The
+        # invalidation is QUEUED (drained under _op_lock — see
+        # _req_flush) and scoped to THIS PG: re-peering one PG must
+        # not make every object in every pool re-pay the quorum
+        # durability poll, and _req_poll_at goes with the windows so
+        # a re-seeded object never eats a stale-cooldown eagain.
+        spec = self.osdmap.pools.get(pg.pool)
+        with self._req_flush_lock:
+            if spec is None:
+                # pool spec gone mid-kick: can't map locs to this PG
+                # any more — flush everything rather than leak stale
+                # windows past the rewind
+                self._req_flush.add(None)
+            else:
+                self._req_flush.add(
+                    ("pg", spec.pool_id, spec.pg_num, pg.pgid)
+                )
         with self._peer_lock:
             pg.peered.clear()
             if pg._peering:
@@ -1714,6 +1762,7 @@ class OSDDaemon:
         if msg.op == "notify":
             return self._op_notify(msg, client_oid)
         with self._op_lock:
+            self._drain_req_flushes()
             polled = None  # durability fan-out, shared consult->resolve
             if msg.op in _MUTATING_OPS and msg.reqid:
                 cached = self._completed_ops.get(msg.reqid)
@@ -1882,6 +1931,49 @@ class OSDDaemon:
                 self._completed_ops.popitem(last=False)
         return reply
 
+    def _drain_req_flushes(self) -> None:
+        """Apply queued reqid-cache invalidations. Caller holds
+        _op_lock; runs before any window is consulted, so an entry a
+        mid-kick op re-inserted (it held _op_lock across the kick)
+        is dropped before the next op can judge from it."""
+        with self._req_flush_lock:
+            if not self._req_flush:
+                return
+            pending, self._req_flush = self._req_flush, set()
+        if None in pending:
+            self._req_windows.clear()
+            self._req_unverified.clear()
+            self._req_poll_at.clear()
+            return
+        from ceph_tpu.placement import stable_hash
+
+        pools = {e[1] for e in pending if e[0] == "pool"}
+        pgs = {(e[1], e[3]): e[2] for e in pending if e[0] == "pg"}
+        doomed = []
+        for loc in (
+            self._req_windows.keys()
+            | self._req_unverified.keys()
+            | self._req_poll_at.keys()
+        ):
+            try:
+                pool_id, oid = split_loc(loc)
+            except ValueError:
+                doomed.append(loc)  # unparseable: never judge from it
+                continue
+            if pool_id in pools:
+                doomed.append(loc)
+                continue
+            for (pid, pgid), pg_num in pgs.items():
+                if pool_id == pid and stable_hash(
+                    str(pid), head_of_loc(oid)
+                ) % pg_num == pgid:
+                    doomed.append(loc)
+                    break
+        for loc in doomed:
+            self._req_windows.pop(loc, None)
+            self._req_unverified.pop(loc, None)
+            self._req_poll_at.pop(loc, None)
+
     def _req_window(self, pg: _PG, loc: str) -> list:
         """This object's reqid window, seeding from the stored attr
         the first time (the takeover path: a new primary reads what
@@ -1903,6 +1995,7 @@ class OSDDaemon:
                 old = next(iter(self._req_windows))
                 self._req_windows.pop(old)
                 self._req_unverified.pop(old, None)
+                self._req_poll_at.pop(old, None)
             self._req_windows[loc] = win
         return win
 

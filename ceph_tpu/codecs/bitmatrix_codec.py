@@ -7,14 +7,21 @@ the coding matrix is [m*w, k*w] over GF(2), and encode/decode is the
 same mod-2 MXU matmul as the byte codes — XOR networks are *natively*
 this formulation on TPU (SURVEY.md section 7 "Design stance").
 
-Construction note: the vendored jerasure/gf-complete sources are not
-present in the reference snapshot (empty submodules), so bit-level
-compatibility with jerasure's exact liberation matrices is untestable;
-instead ``raid6_bitmatrix`` builds minimal-density RAID-6 matrices of
-the same shape the Liberation paper describes (shifted identities plus
-correction bits, w prime), deterministically searched and exhaustively
-verified MDS at construction time. Same envelopes, same schedule
-execution model, stable across versions (corpus-frozen).
+Construction note: the vendored jerasure/gf-complete sources are
+absent from the reference snapshot (empty submodules), so the
+matrices are built from the PUBLISHED definitions rather than the C
+files: ``liberation_bitmatrix`` ports Plank's FAST'08 construction
+(cyclic shifts plus the one correction bit per column, w prime),
+``blaum_roth_bitmatrix`` the Blaum-Roth ring form over
+GF(2)[x]/(1 + x + ... + x^w), and liber8tion's envelope is served by
+``gf2w_power_bitmatrix`` (generator powers, guaranteed MDS at w=8).
+Every construction re-verifies MDS exhaustively at build time, and
+bit-compatibility IS tested: corpus v1 freezes encoded chunks for
+each technique (tests/corpus/v1, tests/test_corpus.py), so the
+matrices — and the kernels applying them — can never drift across
+versions. The earlier searched minimal-density RAID-6 matrices
+(``raid6_bitmatrix``) remain available as ``construction=v0``, pinned
+by the corpus v0 entries.
 """
 
 from __future__ import annotations

@@ -14,9 +14,14 @@ lost chunk rebuild from its small group instead of k survivors —
 the locality property ``minimum_to_decode`` exposes (3-case search,
 ErasureCodeLrc.cc _minimum_to_decode).
 
-TPU note: every inner layer dispatch is itself a batched bit-plane MXU
-call, so a full-stripe LRC encode is len(layers) kernel launches
-regardless of batch size.
+TPU note: a full-stripe encode composes the whole layer cascade into
+ONE [m, k] generator (see init) — a single shards-form kernel dispatch
+regardless of layer count. Decode keeps the layered walk (locality is
+its whole point), and each inner layer decode rides the zero-waste
+shards-form MXU kernel: local repair of one lost chunk is one small
+[1*8, l*8] matmul over the local group's survivors, with no
+block-diagonal padding tax and no [.., C, N] stack relayout
+(ops/pallas_encode.py round-6 packing).
 """
 
 from __future__ import annotations

@@ -317,7 +317,11 @@ class BitplaneDispatchMixin:
         (the stack is a relayout copy that measured 3.5x the kernel's
         own cost on the LRC/SHEC bench geometry — the same finding
         that shaped the XOR-schedule engine's shards form,
-        ops/xor_schedule.py). DCN/mesh routes and the einsum fallback
+        ops/xor_schedule.py). The zero-waste packing widened this
+        route to any c <= pallas_encode.SHARDS_MAX_C: cauchy k=10
+        encode and wide SHEC survivor sets now ride it, where the
+        round-5 block-diagonal rule (s*c <= 16) forced them through
+        the stacked path. DCN/mesh routes and the einsum fallback
         still take the stacked tensor. Returns one array per output
         row-group (R = bitmatrix rows / 8)."""
         from ceph_tpu.ops import pallas_encode as pe

@@ -23,7 +23,6 @@ bit-plane MXU engine as the other matrix codes.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ceph_tpu import PLUGIN_ABI_VERSION
@@ -36,7 +35,7 @@ from ceph_tpu.gf import (
 
 from .base import to_int
 from .interface import ErasureCodeProfile, Flag, SubChunkPlan
-from .matrix_codec import MatrixErasureCodec
+from .matrix_codec import MatrixErasureCodec, dev_bmat
 from .registry import registry
 
 
@@ -271,20 +270,30 @@ class ShecCodec(MatrixErasureCodec):
         if not missing:
             return {s: chunks[s] for s in want_to_read}
         key = ("shec", tuple(sorted(chunks)), tuple(missing))
-        inputs_rows = self._tables.get(
+        inputs, bmat_np = self._tables.get(
             key, lambda: self._build_reconstruction(set(chunks), missing)
         )
-        inputs, bmat_np, bmat_dev = inputs_rows
-        stacked = jnp.stack([chunks[i] for i in inputs], axis=-2)
-        out = self._dispatch_bitmatrix(bmat_np, bmat_dev, stacked, "decode")
+        # shards-form dispatch: the survivors feed the kernel as
+        # per-shard operands (k+m <= 20 always fits the zero-waste
+        # shards form), so shingled repair skips the [.., C, N] stack
+        # relayout the round-5 path paid; the LRU keeps only HOST
+        # matrices and the device copy goes through dev_bmat so a
+        # traced decode never caches its own tracer.
+        shard_list = [chunks[i] for i in inputs]
+        traced = any(isinstance(v, jax.core.Tracer) for v in shard_list)
+        outs = self._dispatch_bitmatrix_shards(
+            bmat_np,
+            dev_bmat(self._tables, key, bmat_np, traced),
+            shard_list, "decode",
+        )
         result = {s: chunks[s] for s in want_to_read if s in chunks}
         for idx, s in enumerate(missing):
-            result[s] = out[..., idx, :]
+            result[s] = outs[idx]
         return result
 
     def _build_reconstruction(
         self, available: set[int], missing: list[int]
-    ) -> tuple[list[int], np.ndarray, jax.Array]:
+    ) -> tuple[list[int], np.ndarray]:
         """One GF matrix mapping survivor chunks -> all missing wanted
         shards: erased data via the inverted shingle system, erased
         parity re-encoded by composition (shec_matrix_decode)."""
@@ -338,8 +347,7 @@ class ShecCodec(MatrixErasureCodec):
                         contrib[None, :],
                     )[0]
                 out_rows.append(vec)
-        bm = gf_matrix_to_bitmatrix(np.stack(out_rows))
-        return inputs, bm, jnp.asarray(bm)
+        return inputs, gf_matrix_to_bitmatrix(np.stack(out_rows))
 
 
 registry.register("shec", ShecCodec, PLUGIN_ABI_VERSION)

@@ -56,6 +56,15 @@ DEFAULT_SUITE: list[tuple[str, dict[str, str]]] = [
 # blaum_roth = Blaum-Roth 1993 ring form, liber8tion = frozen
 # minimal-density search) — the v0 entries for these pin
 # construction=v0, so both matrix generations stay covered forever.
+#
+# Round 6 adds the byte-matrix families (reed_sol_van, cauchy_orig,
+# cauchy_good, isa RS) at geometries the v0 suite does not cover —
+# including the non-power-of-two k the zero-waste kernel pads and the
+# cauchy k=10 bench geometry. Their chunks are additionally pinned
+# against a from-scratch host GF apply of the gf/matrices.py ported
+# constructions (tests/test_zero_waste_packing.py), so the repacked
+# kernels regress against reference-derived vectors, not a v0 freeze
+# of the engine under test.
 V1_SUITE: list[tuple[str, dict[str, str]]] = [
     ("jerasure", {"technique": "liberation", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "liberation", "k": "6", "m": "2",
@@ -63,6 +72,10 @@ V1_SUITE: list[tuple[str, dict[str, str]]] = [
     ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "liber8tion", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "liber8tion", "k": "8", "m": "2"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "5", "m": "3"}),
+    ("jerasure", {"technique": "cauchy_orig", "k": "5", "m": "3"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "10", "m": "4"}),
+    ("isa", {"technique": "reed_sol_van", "k": "6", "m": "3"}),
 ]
 
 SUITES = {"v0": DEFAULT_SUITE, "v1": V1_SUITE}

@@ -29,6 +29,31 @@ def gf_matrix_to_bitmatrix(m: np.ndarray) -> np.ndarray:
     return blocks.transpose(0, 2, 1, 3).reshape(r * 8, c * 8).astype(np.uint8)
 
 
+def plane_major_cols(m: np.ndarray, pad: int = 0) -> np.ndarray:
+    """Reindex bit COLUMNS from shard-major to plane-major, padded.
+
+    Input columns are shard-major (col i*8 + b = bit b of shard i, the
+    ``gf_matrix_to_bitmatrix`` layout); output columns are plane-major
+    (col b*F + i with F = C + pad), matching the contraction order the
+    packed bit-plane unpack produces on device: all shards' bit-b
+    planes are contiguous, with ``pad`` all-zero shard slots per plane
+    (the int32-sublane alignment columns — the ONLY structural zeros
+    the zero-waste kernel packing has left). Vectorized: the round-5
+    builders walked an r*c*64 Python loop per cached matrix, which the
+    wide packet-code matrices (C up to k*w) paid at every cache miss.
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    rows, c8 = m.shape
+    assert c8 % 8 == 0, c8
+    c = c8 // 8
+    x = m.reshape(rows, c, 8).transpose(0, 2, 1)  # [rows, 8, c]
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((rows, 8, pad), np.uint8)], axis=2
+        )
+    return np.ascontiguousarray(x.reshape(rows, 8 * (c + pad)))
+
+
 def bitmatrix_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Matrix product over GF(2)."""
     return (a.astype(np.int64) @ b.astype(np.int64) % 2).astype(np.uint8)

@@ -10,10 +10,19 @@ block), data bytes become 8 bit-planes, and
     parity_bits[m*8, N] = (B @ data_bits[k*8, N]) mod 2
 
 which the MXU executes as an int8 matmul with int32 accumulation (exact:
-max contraction 256 terms), followed by ``& 1`` and bit re-packing. The
-same engine runs decode (B = cached inverted submatrix rows), parity
-delta (B = single generator column), and the Liberation-family native
-bit-matrix codes (packet layout instead of byte bit-planes).
+the products are 0/1, so any contraction we build fits easily), followed
+by ``& 1`` and bit re-packing. The same engine runs decode (B = cached
+inverted submatrix rows), parity delta (B = single generator column),
+and the Liberation-family native bit-matrix codes (packet layout
+instead of byte bit-planes).
+
+Engine invariant (round 6, shared with the Pallas kernels in
+pallas_encode.py): **stripes live on batch/lane axes, never in the
+contraction**. The einsum below batches stripes on the leading axes
+with the bare [R*8, S*8] matrix — zero structural waste — and the
+kernel path now does the same (stripes on the grid and lane axes; the
+round-3..5 kernels block-diagonaled two stripes into the contraction,
+clocking 2x the MACs with half of them zeros).
 
 All functions are shape-polymorphic over leading batch axes and jit/vmap
 friendly (static shapes, no data-dependent control flow).

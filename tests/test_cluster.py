@@ -293,3 +293,99 @@ def test_trimmed_history_forces_full_map():
     mon.trim_history(keep=1)
     assert mon.get_incrementals(0) is None
     assert mon.get_incrementals(mon.osdmap.epoch - 1) is not None
+
+
+# -- reqid-cache invalidation scoping (round-6 _kick_peering fix) -------
+
+def _bare_daemon():
+    """An OSDDaemon shell with just the reqid-cache state — the drain
+    logic is pure dict surgery and must be testable without sockets."""
+    import threading
+
+    from ceph_tpu.cluster.osd_daemon import OSDDaemon
+
+    d = object.__new__(OSDDaemon)
+    d._req_windows = {}
+    d._req_unverified = {}
+    d._req_poll_at = {}
+    d._req_flush = set()
+    d._req_flush_lock = threading.Lock()
+    return d
+
+
+def test_req_flush_scoped_to_kicked_pg():
+    """A queued PG-scoped flush drops exactly that PG's locs — other
+    pools and sibling PGs keep their windows (re-peering one PG must
+    not make every object on the daemon re-pay the durability poll)."""
+    from ceph_tpu.cluster.osd_daemon import make_loc
+    from ceph_tpu.placement import stable_hash
+
+    d = _bare_daemon()
+    pg_num = 8
+    # split pool-1 objects by the PG they hash to
+    locs = [make_loc(1, f"obj{i}") for i in range(32)]
+    kicked = stable_hash("1", "obj0") % pg_num
+    in_pg = [
+        l for l in locs
+        if stable_hash("1", l.split(":", 1)[1]) % pg_num == kicked
+    ]
+    other_pool = make_loc(2, "obj0")
+    for l in locs + [other_pool]:
+        d._req_windows[l] = [("rq", 1)]
+        d._req_unverified[l] = {"rq"}
+        d._req_poll_at[l] = 1.0
+    d._req_flush.add(("pg", 1, pg_num, kicked))
+    d._drain_req_flushes()
+    assert in_pg and all(l not in d._req_windows for l in in_pg)
+    assert all(l not in d._req_unverified for l in in_pg)
+    assert all(l not in d._req_poll_at for l in in_pg)
+    survivors = [l for l in locs if l not in in_pg] + [other_pool]
+    assert all(l in d._req_windows for l in survivors)
+    assert all(l in d._req_poll_at for l in survivors)
+
+
+def test_req_flush_pool_and_full_variants():
+    """Pool-scoped flushes (deletion sweep) drop every loc of that
+    pool; the None sentinel drops everything; unparseable locs are
+    never kept (nothing may judge from them)."""
+    from ceph_tpu.cluster.osd_daemon import make_loc
+
+    d = _bare_daemon()
+    keep = make_loc(7, "x")
+    for l in (make_loc(3, "a"), make_loc(3, "b"), keep, "garbage-loc"):
+        d._req_windows[l] = [("rq", 1)]
+        d._req_poll_at[l] = 2.0
+    d._req_flush.add(("pool", 3))
+    d._drain_req_flushes()
+    assert set(d._req_windows) == {keep}
+    assert set(d._req_poll_at) == {keep}
+    d._req_flush.add(None)
+    d._drain_req_flushes()
+    assert not d._req_windows and not d._req_poll_at
+
+
+def test_pool_deletion_prunes_fence_epochs():
+    """_on_map's deletion sweep drops _fence_epochs for dead pool ids
+    and queues the pool's reqid-cache flush (unbounded-state fix)."""
+    from ceph_tpu.cluster.osd_daemon import OSDDaemon, make_loc
+    from ceph_tpu.store import MemStore
+
+    mon = mk_monitor(6)
+    mk_pool(mon, name="doomed", k=4, m=2)
+    osd = OSDDaemon(0, mon, store=MemStore("t"))
+    try:
+        pool_id = mon.osdmap.pools["doomed"].pool_id
+        osd._fence_epochs[(pool_id, 3)] = 5
+        osd._fence_epochs[(pool_id + 99, 0)] = 7  # unrelated survives
+        osd._req_windows[make_loc(pool_id, "o")] = [("rq", 1)]
+        osd._req_poll_at[make_loc(pool_id, "o")] = 1.0
+        mon.osd_pool_rm("doomed")
+        osd._on_map(mon.osdmap)  # map delivery (subscribe needs start())
+        assert (pool_id, 3) not in osd._fence_epochs
+        assert (pool_id + 99, 0) in osd._fence_epochs
+        with osd._op_lock:
+            osd._drain_req_flushes()
+        assert make_loc(pool_id, "o") not in osd._req_windows
+        assert make_loc(pool_id, "o") not in osd._req_poll_at
+    finally:
+        osd.stop()

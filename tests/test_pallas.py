@@ -108,11 +108,15 @@ def test_traced_then_eager_encode_no_tracer_leak(rng):
     np.testing.assert_array_equal(first, eager)
 
 
-@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (4, 3), (8, 4)])
+@pytest.mark.parametrize(
+    "k,m", [(2, 1), (4, 2), (4, 3), (8, 4), (10, 4), (12, 3)]
+)
 def test_shards_form_matches_stacked(rng, k, m):
-    """The shards-form kernel (per-shard operands, shard-major v4
-    stationary matrix, group loop) is bit-identical to the stacked v3
-    kernel for every geometry the dispatch can route to it."""
+    """The shards-form kernel (per-shard operands, zero-waste
+    stationary matrix, lane-batched group loop) is bit-identical to
+    the stacked kernel for every geometry the dispatch can route to
+    it — including c > 8, which the round-5 block-diagonal packing
+    could not serve."""
     import jax.numpy as jnp
 
     from ceph_tpu.gf import gf_matrix_to_bitmatrix, vandermonde_rs_matrix
@@ -142,6 +146,10 @@ def test_shards_supported_predicate():
 
     assert pe.shards_supported(4, (8, 2048))
     assert pe.shards_supported(8, (256, 65536))
-    assert not pe.shards_supported(9, (8, 2048))    # no viable s
+    # zero-waste packing serves any c up to SHARDS_MAX_C (the round-5
+    # block-diagonal rule stopped at s*c <= 16, i.e. c <= 8)
+    assert pe.shards_supported(9, (8, 2048))
+    assert pe.shards_supported(16, (8, 2048))
+    assert not pe.shards_supported(17, (8, 2048))   # contraction > 128
     assert not pe.shards_supported(4, (7, 2048))    # batch % 8
     assert not pe.shards_supported(4, (8, 1000))    # lane tile
