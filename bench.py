@@ -657,7 +657,15 @@ def _measure_checksums(result: dict) -> None:
     Feedback form: the per-block hash vector's first lanes patch the
     next input; the accumulator folds the full hash vector (the hash
     path is partly plain XLA — a sliced consumer would let XLA
-    dead-code most blocks)."""
+    dead-code most blocks).
+
+    Budget trim (round 7): ONE warmed 32 MB device buffer is reshaped
+    for every block size (the kernels are data-independent, and 32 MB
+    still streams 2x VMEM), the iteration-count ladder runs once on
+    the first config and its counts are reused everywhere (identical
+    bytes/iter => near-identical per-iter time), and reps drop to 3.
+    The old per-key ladder + fresh 64 MB buffers cost the section
+    ~225 s — past the tunnel budget once the fused-path phase landed."""
     try:
         import jax
         import jax.numpy as jnp
@@ -665,6 +673,10 @@ def _measure_checksums(result: dict) -> None:
         from ceph_tpu.checksum.crc32c import crc32c_device
     except Exception:
         return
+
+    size = 32 << 20
+    flat = _device_rand((size,), 3)
+    counts = {"n1": None, "n2": None}
 
     def hash_loop_gbps(hash_fn, blocks, reps=3):
         nblocks, block = blocks.shape
@@ -689,21 +701,30 @@ def _measure_checksums(result: dict) -> None:
             )
             return acc
 
-        per, iqr = _loop_stats(loop, blocks, reps=reps)
+        if counts["n2"] is None:
+            per, iqr = _loop_stats(loop, blocks, reps=reps)
+            # reuse this config's auto-scaled span for the rest of the
+            # section: every config streams the same bytes per iter
+            base = min(_timed(loop, blocks, 1) for _ in range(2))
+            n2 = max(60, int(SPAN_TARGET_S / max(per, 1e-6)))
+            counts["n1"], counts["n2"] = max(1, n2 // 10), n2
+        else:
+            per, iqr = _loop_stats(
+                loop, blocks, n1=counts["n1"], n2=counts["n2"],
+                reps=reps,
+            )
         g = nblocks * block / per / 1e9
         return g, g - nblocks * block / (per + iqr) / 1e9
 
-    size = 64 << 20
     for key, block in (
         ("crc32c_gbps", 4096),
         ("crc32c_16k_gbps", 16384),
         ("crc32c_64k_gbps", 65536),
     ):
         try:
-            blocks = _device_rand((size // block, block), 3)
-            reps = 5 if key == "crc32c_gbps" else 3
+            blocks = flat.reshape(size // block, block)
             g, iqr = hash_loop_gbps(
-                lambda b: crc32c_device(b, 0xFFFFFFFF), blocks, reps=reps
+                lambda b: crc32c_device(b, 0xFFFFFFFF), blocks
             )
             result[key] = round(g, 1)
             result[key + "_iqr"] = round(iqr, 1)
@@ -712,7 +733,7 @@ def _measure_checksums(result: dict) -> None:
     try:
         from ceph_tpu.checksum.xxhash import xxh32_device, xxh64_device
 
-        blocks = _device_rand((size // 4096, 4096), 4)
+        blocks = flat.reshape(size // 4096, 4096)
         g, iqr = hash_loop_gbps(lambda b: xxh32_device(b), blocks)
         result["xxhash32_gbps"] = round(g, 1)
         result["xxhash32_iqr"] = round(iqr, 1)
@@ -728,6 +749,121 @@ def _measure_checksums(result: dict) -> None:
         result["xxhash64_iqr"] = round(iqr, 1)
     except Exception:
         pass
+
+
+def _measure_fused_write_path(result: dict, enc_gbps: float) -> None:
+    """Tentpole metric (round 7): the whole write path's device cost —
+    parity AND per-4K-block crc32c for all k+m shards — three ways:
+
+    - ``fused_write_path_gbps``: the fused encode+csum kernel, ONE
+      pass over the data while it is resident for the encode matmul;
+    - ``write_path_sep_gbps``: the plain encode kernel followed by a
+      separate ``crc32c_device`` pass over data + parity (re-reads
+      every byte encode just wrote — the extra HBM pass fusion kills);
+    - ``write_path_host_gbps``: device encode + HOST csum, composed
+      analytically from a 4 MB host-hash sample (hashing 96 MB/iter
+      on the host directly would burn minutes of tunnel time for a
+      number whose magnitude is not in doubt).
+
+    ``fused_vs_sep`` is the headline ratio (acceptance: >= 1.3x)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.checksum.crc32c import crc32c_device
+        from ceph_tpu.gf import (
+            gf_matrix_to_bitmatrix,
+            vandermonde_rs_matrix,
+        )
+        from ceph_tpu.ops import pallas_encode as pe
+
+        if not pe.on_tpu():
+            return
+        cb = 4096
+        g = vandermonde_rs_matrix(K, M)
+        bmat = gf_matrix_to_bitmatrix(g[K:, :])
+        data = _device_rand((BATCH, K, CHUNK), 9)
+        nbytes = BATCH * K * CHUNK
+
+        def csum_feedback(p, cs, d, i):
+            # fold BOTH outputs into the next input: iterations are
+            # serially dependent through parity AND csums, so neither
+            # leg can be elided/overlapped (methodology note 1)
+            fold = jax.lax.dynamic_slice(p, (0, 0, 0), (1, 1, 128))
+            cfold = jnp.tile(
+                jax.lax.dynamic_slice(
+                    cs, (0, 0, 0), (1, 1, 32)
+                ).astype(jnp.uint8),
+                (1, 1, 4),
+            )
+            patch = fold ^ cfold ^ jnp.uint8(i + 1)
+            d = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+            return d, fold.reshape(-1)[0] ^ cfold.reshape(-1)[0]
+
+        @jax.jit
+        def loop_fused(d0, iters):
+            def body(i, carry):
+                d, acc = carry
+                p, cs = pe.gf_encode_csum_bitplane_pallas(bmat, d, cb)
+                d, scalar = csum_feedback(p, cs, d, i)
+                return d, acc ^ scalar
+
+            _, acc = jax.lax.fori_loop(
+                0, iters, body, (d0, jnp.uint8(0))
+            )
+            return acc
+
+        @jax.jit
+        def loop_sep(d0, iters):
+            def body(i, carry):
+                d, acc = carry
+                p = pe.gf_encode_bitplane_pallas(bmat, d)
+                cs_d = crc32c_device(
+                    d.reshape(BATCH, K, CHUNK // cb, cb), 0
+                )
+                cs_p = crc32c_device(
+                    p.reshape(BATCH, M, CHUNK // cb, cb), 0
+                )
+                cs = jnp.concatenate([cs_d, cs_p], axis=1)
+                d, scalar = csum_feedback(p, cs, d, i)
+                return d, acc ^ scalar
+
+            _, acc = jax.lax.fori_loop(
+                0, iters, body, (d0, jnp.uint8(0))
+            )
+            return acc
+
+        per_f, iqr_f = _loop_stats(loop_fused, data, reps=3)
+        per_s, _ = _loop_stats(loop_sep, data, reps=3)
+        fused_gbps = nbytes / per_f / 1e9
+        result["fused_write_path_gbps"] = round(fused_gbps, 2)
+        result["fused_write_path_iqr"] = round(
+            fused_gbps - nbytes / (per_f + iqr_f) / 1e9, 2
+        )
+        result["write_path_sep_gbps"] = round(nbytes / per_s / 1e9, 2)
+        result["fused_vs_sep"] = round(per_s / per_f, 2)
+
+        # host-csum comparator: sample the host scalar rate, compose
+        from ceph_tpu.checksum import crc32c_scalar
+
+        sample = np.random.default_rng(10).integers(
+            0, 256, 4 << 20, np.uint8
+        ).tobytes()
+        crc32c_scalar(0xFFFFFFFF, sample[:cb])  # warm native load
+        t0 = time.perf_counter()
+        for off in range(0, len(sample), cb):
+            crc32c_scalar(0xFFFFFFFF, sample[off : off + cb])
+        host_gbps = len(sample) / (time.perf_counter() - t0) / 1e9
+        result["host_csum_gbps"] = round(host_gbps, 3)
+        csum_bytes = BATCH * (K + M) * CHUNK
+        t_total = nbytes / (enc_gbps * 1e9) + csum_bytes / (
+            host_gbps * 1e9
+        )
+        result["write_path_host_gbps"] = round(
+            nbytes / t_total / 1e9, 2
+        )
+    except Exception:
+        pass  # scorecard entries are best-effort; headline must print
 
 
 def _tunnel_rtt_ms() -> float | None:
@@ -793,6 +929,8 @@ def main() -> None:
         _measure_reconstruct_latency(result)
     with _phase("checksums"):
         _measure_checksums(result)
+    with _phase("fused_write_path"):
+        _measure_fused_write_path(result, enc_gbps)
     rtt_end = _tunnel_rtt_ms()
     if rtt_end is not None:
         result["tunnel_rtt_end_ms"] = rtt_end

@@ -14,21 +14,33 @@ block, so it runs as a ``lax.scan`` over stripes vmapped across blocks
 (``u64.py``) — JAX x64 stays off.
 """
 
+from . import backends
 from .checksummer import (
     CSUM_ALGORITHMS,
     Checksummer,
+    crc32c_scalar,
     csum_value_size,
 )
 from .crc32c import crc32c as crc32c_host
-from .crc32c import crc32c_device
+from .crc32c import (
+    crc32c_chain,
+    crc32c_device,
+    crc32c_seed_shift,
+    crc32c_stream,
+)
 from .reference import crc32c_ref, xxh32_ref, xxh64_ref
 
 __all__ = [
     "CSUM_ALGORITHMS",
     "Checksummer",
+    "backends",
+    "crc32c_chain",
     "crc32c_host",
     "crc32c_device",
     "crc32c_ref",
+    "crc32c_scalar",
+    "crc32c_seed_shift",
+    "crc32c_stream",
     "csum_value_size",
     "xxh32_ref",
     "xxh64_ref",

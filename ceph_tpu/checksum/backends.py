@@ -1,0 +1,74 @@
+"""Checksum backend observability.
+
+``checksum/pallas_crc.supported()`` used to fall back silently: a
+bench or test had no way to tell whether a crc actually rode the
+Pallas MXU fold, the XLA einsum engine, the host native/bitwise
+scalar path, or arrived precomputed from the fused encode+csum
+kernel. Every routing decision now records here — plain module-level
+counters (no locks: increments are GIL-atomic and these sit on
+messenger/store hot paths), a last-backend marker the ``Checksummer``
+facade surfaces per call, and a log-once for the silent-fallback case.
+
+Backends:
+- ``pallas``  — the MXU fold kernel (checksum/pallas_crc.py)
+- ``einsum``  — the XLA einsum fold (checksum/crc32c.py)
+- ``host``    — host scalar path (native C or the bitwise oracle)
+- ``device``  — non-crc device kernels (xxhash scan family)
+- ``fused``   — csums emitted by the fused encode+checksum kernel
+  (ops/pallas_encode.py) — no standalone checksum pass ran at all
+
+``pallas_fallback`` counts dispatches where the Pallas fold was
+enabled on TPU but the shape could not tile (the silent fallback the
+round-6 advice flagged).
+"""
+
+from __future__ import annotations
+
+_counts: dict[str, int] = {}
+_bytes: dict[str, int] = {}
+_last: str | None = None
+_warned: set[str] = set()
+
+
+def record(backend: str, nbytes: int = 0) -> None:
+    global _last
+    _counts[backend] = _counts.get(backend, 0) + 1
+    if nbytes:
+        _bytes[backend] = _bytes.get(backend, 0) + int(nbytes)
+    if not backend.endswith("_fallback"):
+        _last = backend
+
+
+def last_backend() -> str | None:
+    """Backend of the most recent checksum computation."""
+    return _last
+
+
+def counts() -> dict[str, int]:
+    return dict(_counts)
+
+
+def bytes_hashed() -> dict[str, int]:
+    return dict(_bytes)
+
+
+def reset() -> None:
+    global _last
+    _counts.clear()
+    _bytes.clear()
+    _last = None
+    _warned.clear()
+
+
+def warn_once(key: str, msg: str) -> None:
+    """Log a routing surprise exactly once per process (the
+    supported()-fell-back case must be visible, not spammy)."""
+    if key in _warned:
+        return
+    _warned.add(key)
+    try:
+        from ceph_tpu.utils.log import get_logger
+
+        get_logger("checksum").info(msg)
+    except Exception:
+        pass

@@ -9,14 +9,42 @@ exact value widths (Checksummer.h:63-73): crc32c (u32), crc32c_16
 
 Defaults match the reference: init_value -1 → all-ones register for
 CRC (the BlueStore convention) and all-ones seed for xxhash.
+
+Backend policy (the write-path fusion work, round 7): the crc32c
+family routes host-staged batches below ``csum_device_min_bytes``
+through the host scalar path (native C when loaded) — per-dispatch
+device latency dwarfs the math there — and everything larger through
+the device fold (Pallas MXU kernel on TPU when the shape tiles, XLA
+einsum otherwise). Device-resident inputs always stay on device.
+Every call records which backend served it (``checksum.backends``);
+``Checksummer.last_backend`` exposes the choice per instance. Note
+the HOT write path does not pass through here at all when the fused
+encode+csum kernel runs (ops/pallas_encode.py): blob and HashInfo
+csums then arrive precomputed from the encode dispatch, and this
+facade is the verify/fallback tier.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from . import backends
 from .crc32c import crc32c_device
 from .xxhash import xxh32_device, xxh64_device
+
+
+def crc32c_scalar(init: int, data) -> int:
+    """Host scalar crc32c behind the Checksummer facade — THE
+    sanctioned host-fallback entry point (import hygiene forbids
+    ``checksum.host`` outside checksum/ and tests/, so the host path
+    cannot silently creep back into pipeline/store code). Records the
+    ``host`` backend."""
+    from .host import crc32c as _host_crc
+
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    backends.record("host", len(data))
+    return _host_crc(init, data)
 
 
 class _Alg:
@@ -34,6 +62,23 @@ class _Crc32c(_Alg):
 
     def digest_blocks(self, blocks, init_value):
         init = init_value & 0xFFFFFFFF
+        if isinstance(blocks, np.ndarray):
+            from ceph_tpu.utils import config
+
+            limit = int(config.get("csum_device_min_bytes"))
+            if limit > 0 and blocks.nbytes < limit:
+                from .host import crc32c as _host_crc
+
+                backends.record("host", blocks.nbytes)
+                out = np.fromiter(
+                    (
+                        _host_crc(init, blocks[i].tobytes())
+                        for i in range(blocks.shape[0])
+                    ),
+                    dtype=np.uint32,
+                    count=blocks.shape[0],
+                )
+                return (out & self.mask).astype(self.value_dtype)
         out = np.asarray(crc32c_device(blocks, init))
         return (out & self.mask).astype(self.value_dtype)
 
@@ -56,6 +101,7 @@ class _XxHash32(_Alg):
 
     def digest_blocks(self, blocks, init_value):
         seed = init_value & 0xFFFFFFFF
+        backends.record("device", getattr(blocks, "nbytes", 0))
         return np.asarray(xxh32_device(blocks, seed)).astype(self.value_dtype)
 
 
@@ -65,6 +111,7 @@ class _XxHash64(_Alg):
 
     def digest_blocks(self, blocks, init_value):
         seed = init_value & 0xFFFFFFFFFFFFFFFF
+        backends.record("device", getattr(blocks, "nbytes", 0))
         hi, lo = xxh64_device(blocks, seed)
         return (
             (np.asarray(hi).astype(np.uint64) << np.uint64(32))
@@ -125,7 +172,14 @@ def _as_blocks(
 
 class Checksummer:
     """Block-checksum facade; one instance per (algorithm, block size),
-    like a BlueStore blob's csum settings (bluestore_types.h)."""
+    like a BlueStore blob's csum settings (bluestore_types.h).
+
+    ``calculate``/``verify`` batch blocks through the backend policy
+    at the top of this module (host scalar below the device
+    threshold, Pallas/einsum device fold above, device-resident
+    inputs always on device); after each call ``last_backend`` names
+    the backend that actually ran — the observability the round-6
+    silent-fallback advice asked for."""
 
     def __init__(self, alg: str, csum_block_size: int = 4096) -> None:
         if alg not in CSUM_ALGORITHMS:
@@ -137,6 +191,9 @@ class Checksummer:
             raise ValueError("csum_block_size must be a power of two")
         self.alg = CSUM_ALGORITHMS[alg]
         self.block_size = csum_block_size
+        #: backend that served the most recent calculate/verify call
+        #: ("host" | "pallas" | "einsum" | "device" | None)
+        self.last_backend: str | None = None
 
     def calculate(
         self,
@@ -147,7 +204,9 @@ class Checksummer:
         block multiple — the reference asserts the same,
         Checksummer.h:215)."""
         blocks = _as_blocks(data, self.block_size)
-        return self.alg.digest_blocks(blocks, init_value)
+        out = self.alg.digest_blocks(blocks, init_value)
+        self.last_backend = backends.last_backend()
+        return out
 
     def verify(
         self,
@@ -162,6 +221,7 @@ class Checksummer:
         ``init_value`` must match the one used at calculate time."""
         blocks = _as_blocks(data, self.block_size)
         got = self.alg.digest_blocks(blocks, init_value)
+        self.last_backend = backends.last_backend()
         expect = np.asarray(csum_data, dtype=self.alg.value_dtype)[
             offset // self.block_size : offset // self.block_size
             + blocks.shape[0]

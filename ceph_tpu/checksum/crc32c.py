@@ -209,14 +209,26 @@ def crc32c_device(
 
     from . import pallas_crc
 
-    if (
-        config.get("ec_use_pallas")
-        and pallas_crc.supported(int(flat.shape[0]), block_bytes)
-    ):
+    from . import backends
+
+    if config.get("ec_use_pallas"):
         from ceph_tpu.ops.pallas_encode import on_tpu
 
         if on_tpu():
-            return pallas_crc.crc32c_fold_pallas(flat, init).reshape(lead)
+            if pallas_crc.supported(int(flat.shape[0]), block_bytes):
+                backends.record("pallas", int(flat.size))
+                return pallas_crc.crc32c_fold_pallas(flat, init).reshape(
+                    lead
+                )
+            # the round-6 silent fallback, now visible: Pallas was
+            # enabled on TPU but the shape could not tile
+            backends.record("pallas_fallback")
+            backends.warn_once(
+                f"crc-untileable-{flat.shape[0]}x{block_bytes}",
+                f"crc32c [{flat.shape[0]}, {block_bytes}] untileable "
+                "for the Pallas fold; serving via einsum",
+            )
+    backends.record("einsum", int(flat.size))
     c = _pick_chunk(block_bytes)
     k_fold, a_total = _device_fold(block_bytes, c)
     out = _crc32c_kernel(
@@ -247,3 +259,60 @@ def crc32c_concat(crc_a: int, crc_b_zero_init: int, len_b: int) -> int:
     buffer.cc): crc(A||B) = A_{len_b} @ crc(A) ⊕ crc_0(B)."""
     a = _mat(zero_gap_matrix(len_b))
     return _pack32((a @ _bits32(crc_a)) & 1) ^ crc_b_zero_init
+
+
+# -- fused-kernel csum plumbing ----------------------------------------
+def crc32c_seed_shift(block_bytes: int, init: int) -> int:
+    """The constant with crc(init, B) = crc(0, B) ^ shift for EVERY
+    block of ``block_bytes`` (linearity: the init register's journey
+    through the message is independent of the message bits). The
+    fused encode+csum kernel emits ZERO-INIT per-block csums so one
+    device pass serves every consumer seed — BlueStore blob csums
+    (seed -1), HashInfo chains, wire csums — via this one XOR."""
+    return _pack32(
+        (_mat(zero_gap_matrix(block_bytes)) @ _bits32(init)) & 1
+    )
+
+
+def crc32c_chain(init: int, block_csums, block_bytes: int) -> int:
+    """Fold ZERO-INIT per-block crc32c values into a running register:
+    repeated range concatenation, cum' = A_block @ cum ⊕ crc_0(B_i).
+    How HashInfo seeds cumulative shard hashes from fused-kernel csums
+    without ever touching the bytes again."""
+    a = _mat(zero_gap_matrix(block_bytes))
+    reg = _bits32(init)
+    for c0 in np.asarray(block_csums).reshape(-1):
+        reg = ((a @ reg) & 1) ^ _bits32(int(c0))
+    return _pack32(reg)
+
+
+def crc32c_stream(data, init: int = 0xFFFFFFFF) -> int:
+    """Cumulative crc32c of one byte stream, backend-routed: host
+    scalar (native C when loaded) below ``csum_device_min_bytes``,
+    device-batched fold above — whole blocks ride ``crc32c_device``
+    zero-init and chain via ``crc32c_chain``; a ragged tail finishes
+    on the host. Callers chain across pieces by passing the previous
+    return as ``init`` (the deep-scrub stride loop)."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        buf = np.frombuffer(data, dtype=np.uint8)
+    else:
+        buf = np.ascontiguousarray(data, dtype=np.uint8).reshape(-1)
+    from ceph_tpu.utils import config
+
+    from . import backends
+    from .host import crc32c as _host_crc
+
+    n = int(buf.size)
+    limit = int(config.get("csum_device_min_bytes"))
+    if limit <= 0 or n < limit:
+        backends.record("host", n)
+        return _host_crc(init, buf.tobytes())
+    cb = 65536 if n >= 4 * 65536 else 4096
+    nb = n // cb
+    blocks = buf[: nb * cb].reshape(nb, cb)
+    c0 = np.asarray(crc32c_device(blocks, 0))
+    reg = crc32c_chain(init, c0, cb)
+    tail = buf[nb * cb :]
+    if tail.size:
+        reg = _host_crc(reg, tail.tobytes())
+    return reg

@@ -44,6 +44,27 @@ SUB_BYTES = 2048
 BLOCK_TILE = 512
 
 
+def plane_fold_kb(block_bytes: int) -> np.ndarray:
+    """[8, block_bytes, 32] int8 per-plane fold matrices for ONE
+    zero-init csum block: kb[b][p, :] = the 32 crc-register bits
+    contributed by bit b of byte p of the block.
+
+    This is the fold machinery the fused encode+checksum epilogue
+    (ops/pallas_encode.gf_encode_csum_bitplane_pallas) keeps stationary
+    in VMEM: the encode kernel already holds each tile's bit planes in
+    registers, so per-block CRCs are 8 extra [rows, block] @ kb[b]
+    dots — no second unpack, no second HBM pass."""
+    from .crc32c import _pick_chunk, fold_tensor
+
+    c = _pick_chunk(block_bytes)
+    kf = fold_tensor(block_bytes, c)  # [S, 32, c*8]
+    flat = np.transpose(kf, (1, 0, 2)).reshape(32, block_bytes * 8)
+    out = np.empty((8, block_bytes, 32), dtype=np.int8)
+    for b in range(8):
+        out[b] = flat[:, b::8].T
+    return out
+
+
 def _plane_major_kt(k_fold: np.ndarray, c: int) -> np.ndarray:
     """[S, 32, c*8] fold tensor -> [nsub, SUB*8, 32] transposed K with
     rows in plane-major order (row b*SUB + j = bit b of byte j within

@@ -1578,8 +1578,18 @@ class OSDDaemon:
             conn.send(Pong(msg.tid, self.osd_id))
         elif isinstance(msg, ECSubWrite):
             oids = msg.txn.oids()
-            loc = split_shard_key(oids[0])[0] if oids else ""
-            if not self._sub_write_interval_ok(msg, loc):
+            # Fence EVERY distinct object in the transaction, not just
+            # oids[0]: a txn touching objects in more than one PG must
+            # clear every PG's fence epoch, or a superseded primary
+            # could slip a stale sub-write past the fence through a
+            # multi-object batch (ADVICE round-5 item).
+            locs = list(dict.fromkeys(
+                split_shard_key(o)[0] for o in oids
+            )) or [""]
+            loc = locs[0]
+            if not all(
+                self._sub_write_interval_ok(msg, l) for l in locs
+            ):
                 # interval fence (OSD::require_same_or_newer_map /
                 # the MOSDECSubOpWrite map_epoch check): a superseded
                 # primary whose map lags behind mine must not commit

@@ -59,6 +59,17 @@ def _dispatch_counters():
             "(sparse packet bit-matrices)",
         )
     b.add_u64_counter(
+        "fused_encode",
+        "encodes served by the fused encode+checksum kernel (parity "
+        "AND per-block crc32c in one device pass)",
+    )
+    b.add_u64_counter(
+        "fused_fallback",
+        "fused encode+csum requests the kernel could not serve "
+        "(untileable shape / non-TPU without interpret) — parity "
+        "encoded normally, csums fell back to the host tier",
+    )
+    b.add_u64_counter(
         "pallas_fallback",
         "dispatches where Pallas was enabled on TPU but the shape "
         "could not tile (chunk axis % LANE_TILE != 0)",
@@ -381,6 +392,72 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
         shards, xp = self._shard_list_xp(data)
         parity = self._encode_shards(shards, xp)
         return {self.k + i: parity[i] for i in range(self.m)}
+
+    def encode_chunks_with_csums(
+        self, data: dict[int, jax.Array], csum_block: int
+    ):
+        """Fused encode+checksum dispatch: (parity dict, csums) where
+        ``csums`` is ``[..., k+m, nblocks]`` uint32 ZERO-INIT per-block
+        crc32c (row i = shard i; seed conversion is a constant XOR,
+        checksum.crc32c.crc32c_seed_shift). Returns ``(None, None)``
+        when no fused kernel route can serve the shape — callers then
+        encode normally and keep their host csum fallback. The fused
+        route runs on TPU, or off-TPU in Pallas interpreter mode when
+        ``ec_fused_csum_interpret`` is set (tests/CI)."""
+        from ceph_tpu.ops import pallas_encode as pe
+        from ceph_tpu.utils import config
+
+        if not (
+            config.get("ec_fused_csum") and config.get("ec_use_pallas")
+        ):
+            return None, None
+        interpret = None
+        if not pe.on_tpu():
+            if not config.get("ec_fused_csum_interpret"):
+                return None, None
+            interpret = True
+        shards, _xp = self._shard_list_xp(data)
+        c = len(shards)
+        shape = shards[0].shape[:-1] + (c, shards[0].shape[-1])
+        if self._mesh_routable_shape(shape) or self._dcn_routable_shape(
+            shape, all(isinstance(v, np.ndarray) for v in shards)
+        ):
+            return None, None  # multi-chip routes own those shapes
+        if pe.fused_csum_shards_supported(
+            c, shards[0].shape, csum_block
+        ) and not all(isinstance(v, np.ndarray) for v in shards):
+            # device-resident per-shard inputs skip the stack relayout
+            _dispatch_counters().inc("fused_encode")
+            parity, csums = pe.gf_encode_csum_bitplane_pallas_shards(
+                self._encode_bmat_np, shards, csum_block,
+                interpret=interpret,
+            )
+            return (
+                {self.k + j: parity[j] for j in range(self.m)},
+                csums,
+            )
+        stacked_shape = (
+            (int(np.prod(shards[0].shape[:-1], initial=1)),)
+            + (c, shards[0].shape[-1])
+        )
+        if not pe.fused_csum_supported(stacked_shape, csum_block):
+            _dispatch_counters().inc("fused_fallback")
+            return None, None
+        _dispatch_counters().inc("fused_encode")
+        stacked = self._stack(list(shards))
+        lead = stacked.shape[:-2]
+        flat = stacked.reshape(stacked_shape)
+        parity, csums = pe.gf_encode_csum_bitplane_pallas(
+            self._encode_bmat_np, jnp.asarray(flat), csum_block,
+            interpret=interpret,
+        )
+        n = shards[0].shape[-1]
+        parity = parity.reshape(lead + (self.m, n))
+        csums = csums.reshape(lead + (c + self.m, n // csum_block))
+        return (
+            {self.k + j: parity[..., j, :] for j in range(self.m)},
+            csums,
+        )
 
     def _encode_shards(self, shards: list, xp) -> list:
         """Dispatch the parity matmul: host GF tables for small numpy

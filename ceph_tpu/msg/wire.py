@@ -30,7 +30,7 @@ from __future__ import annotations
 import struct
 import zlib
 
-from ceph_tpu.checksum.host import crc32c as _crc32c_host
+from ceph_tpu.checksum import crc32c_scalar as _crc32c_host
 
 MAGIC = b"CTv2"
 _HDR = struct.Struct("<4sHBBQ")  # magic, type, flags, nseg, seq

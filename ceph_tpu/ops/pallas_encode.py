@@ -470,6 +470,375 @@ def on_tpu() -> bool:
         return False
 
 
+# ------------------------------------------------- fused encode+checksum
+# One device pass for the whole write path: while each stripe's data
+# tiles are resident for the encode matmul, fold per-csum-block CRC32C
+# for the k data shards from the SAME bit planes the matmul consumes,
+# and fold the freshly-produced parity tiles before they leave VMEM —
+# [shards, nblocks] u32 csums emitted alongside parity in one
+# pallas_call. The separate checksum pass (which re-read every byte
+# encode just wrote) disappears; at hbm_roofline_frac ~0.34 the write
+# path is bandwidth-bound, so that second HBM pass was the bill.
+#
+# The fold reuses checksum/pallas_crc's table machinery
+# (plane_fold_kb): per plane b a stationary [cb, 32] matrix whose row
+# p holds the crc-register contribution of bit b of byte p — the CRC
+# of one block is 8 extra [rows, cb] @ kb[b] MXU dots over bits the
+# kernel already holds. Csums come out ZERO-INIT; any seed is a
+# constant XOR on the host (checksum.crc32c.crc32c_seed_shift), so
+# one kernel output serves BlueStore blob csums (seed -1), HashInfo
+# chaining, and wire csums alike.
+
+
+@functools.lru_cache(maxsize=8)
+def _kb_cached(csum_block: int) -> np.ndarray:
+    """NUMPY only (the _zw_matrix_cached trace-safety rule)."""
+    from ceph_tpu.checksum.pallas_crc import plane_fold_kb
+
+    return plane_fold_kb(csum_block)
+
+
+def _crc_fold_tile(
+    planes, parity8, kb_ref, c, f, r, rp, cb, interpret: bool
+):
+    """CRC32C fold epilogue for ONE stripe's resident tile.
+
+    ``planes`` are the data bit planes the encode matmul just consumed
+    ([8F, T], plane-major); ``parity8`` the packed parity bytes
+    ([R, T]) — unpacked once more in registers (rows padded to the
+    int32 sublane granularity), never via HBM. Returns [C+R, nb*32]
+    int32 fold counts: per csum block q and plane b one
+    [C+R, cb] @ kb[b] dot, summed over the 8 planes — contraction cb,
+    exactly the pallas_crc discipline, minus its unpack (already
+    paid) and minus its HBM read (the data never left VMEM)."""
+    t = parity8.shape[1]
+    if rp > r:
+        parity8 = jnp.concatenate(
+            [parity8, jnp.zeros((rp - r, t), jnp.uint8)], axis=0
+        )
+    pplanes = unpack_bitplanes(parity8, interpret)  # [8*rp, T]
+    nb = t // cb
+    accs = []
+    for q in range(nb):
+        lo = q * cb
+        acc = None
+        for b in range(8):
+            rows = jnp.concatenate(
+                [
+                    planes[b * f : b * f + c, lo : lo + cb],
+                    pplanes[b * rp : b * rp + r, lo : lo + cb],
+                ],
+                axis=0,
+            )  # [C+R, cb] bits of plane b
+            part = jax.lax.dot_general(
+                rows, kb_ref[b],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [C+R, 32]
+            acc = part if acc is None else acc + part
+        accs.append(acc)
+    return accs[0] if nb == 1 else jnp.concatenate(accs, axis=1)
+
+
+def _csum_pack(acc, c, r, cb):
+    """[B, C+R, (N/cb)*32] int32 fold counts -> [B, C+R, N/cb] uint32
+    zero-init csums (mod 2 + LSB-first bit pack) — the tiny epilogue
+    outside the kernel, same as pallas_crc's."""
+    batch = acc.shape[0]
+    bits = (acc.reshape(batch, c + r, -1, 32) & 1).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def _make_fused_kernel(c, r, s, pad, cb, interpret: bool):
+    f = c + pad
+    rp = -(-r // 4) * 4
+
+    def kernel(bmat_ref, kb_ref, data_ref, out_ref, csum_ref):
+        d = data_ref[:]  # [S, C, T] uint8
+        t = d.shape[2]
+        planes = []
+        for si in range(s):
+            flat = d[si]
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
+                )
+            planes.append(unpack_bitplanes(flat, interpret))
+        bits = planes[0] if s == 1 else jnp.concatenate(planes, axis=1)
+        out8 = _matmul_pack(bmat_ref[:], bits, r, interpret)  # [R, S*T]
+        nb = t // cb
+        for si in range(s):
+            tile = out8[:, si * t : (si + 1) * t]
+            fold = _crc_fold_tile(
+                planes[si], tile, kb_ref, c, f, r, rp, cb, interpret
+            )
+            if s == 1:
+                out_ref[:] = tile.reshape(1, r, t)
+                csum_ref[:] = fold.reshape(1, c + r, nb * 32)
+            else:
+                out_ref[si] = tile
+                csum_ref[si] = fold
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("c", "r", "s", "pad", "lane_tile", "cb", "interpret"),
+)
+def _apply_tiled_csum(
+    bmat_big, kb, data, c, r, s, pad, lane_tile, cb, interpret=False
+):
+    batch, _, n = data.shape
+    nb = lane_tile // cb
+    parity, acc = pl.pallas_call(
+        _make_fused_kernel(c, r, s, pad, cb, interpret),
+        grid=(batch // s, n // lane_tile),
+        in_specs=[
+            pl.BlockSpec(bmat_big.shape, lambda b, ch: (0, 0)),
+            pl.BlockSpec(kb.shape, lambda b, ch: (0, 0, 0)),
+            pl.BlockSpec((s, c, lane_tile), lambda b, ch: (b, 0, ch)),
+        ],
+        out_specs=[
+            pl.BlockSpec((s, r, lane_tile), lambda b, ch: (b, 0, ch)),
+            pl.BlockSpec((s, c + r, nb * 32), lambda b, ch: (b, 0, ch)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, r, n), jnp.uint8),
+            jax.ShapeDtypeStruct(
+                (batch, c + r, (n // cb) * 32), jnp.int32
+            ),
+        ],
+        interpret=interpret,
+    )(bmat_big, kb, data)
+    return parity, _csum_pack(acc, c, r, cb)
+
+
+def fused_csum_supported(data_shape: tuple[int, ...], csum_block: int) -> bool:
+    """Stacked-form gate: the encode kernel's own preconditions plus a
+    csum block that the lane tiling can respect (power of two >= 256
+    dividing the chunk axis)."""
+    return (
+        supported(data_shape)
+        and csum_block >= 256
+        and csum_block & (csum_block - 1) == 0
+        and data_shape[-1] % csum_block == 0
+    )
+
+
+def _pick_fused_tile(n: int, cb: int, cap: int = MAX_LANE_TILE) -> int:
+    """Largest tile <= cap that divides the chunk AND is a multiple of
+    both the lane granularity and the csum block (so every csum block
+    lives wholly inside one grid step — no cross-step accumulator)."""
+    step = max(cb, LANE_TILE)
+    t = max(step, (min(cap, n) // step) * step)
+    while t > step and n % t:
+        t -= step
+    return t
+
+
+def gf_encode_csum_bitplane_pallas(
+    bitmatrix,
+    data: jax.Array,
+    csum_block: int,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused encode+checksum: same parity as
+    ``gf_encode_bitplane_pallas`` PLUS ``[B, C+R, N//csum_block]``
+    uint32 ZERO-INIT per-block CRC32C csums (rows 0..C-1 = the data
+    shards in input order, C..C+R-1 = the parity rows), all from one
+    pallas_call. Callers gate with ``fused_csum_supported``."""
+    if interpret is None:
+        interpret = not on_tpu()
+    mat = np.ascontiguousarray(np.asarray(bitmatrix, dtype=np.uint8))
+    r8, c8 = mat.shape
+    batch, c, n = data.shape
+    if c8 != c * 8:
+        raise ValueError(f"bitmatrix cols {c8} != shards*8 {c * 8}")
+    if not fused_csum_supported(data.shape, csum_block):
+        raise ValueError(
+            f"shape {data.shape} x csum_block {csum_block} untileable"
+        )
+    pad = (-c) % 4
+    key = (mat.tobytes(), r8, c8, pad)
+    big = _zw_matrix_cached(*key)
+    kb = _kb_cached(csum_block)
+    r = r8 // 8
+    f = c + pad
+    # the fused epilogue adds the kb fold table (8*cb*32 int8) and the
+    # parity bit planes to the plain kernel's VMEM budget, and traced
+    # callers cannot retry a failed compile — cap the tile at the
+    # shards-form 32 KiB (measured no slower than 64 KiB where both
+    # compiled), with the plain kernel's wide-contraction shrink on top
+    cap = SHARDS_MAX_TILE if f <= 32 else max(
+        max(csum_block, LANE_TILE), (65536 * 32) // f
+    )
+    tile = _pick_fused_tile(n, csum_block, cap)
+    s = _pick_lane_batch(batch, tile)
+    if not isinstance(data, jax.core.Tracer):
+        big = _dev_cached(key, big)
+        kb = _dev_cached(("kb", csum_block), kb)
+    else:
+        return _apply_tiled_csum(
+            big, kb, data, c, r, s, pad, tile, csum_block,
+            interpret=interpret,
+        )
+    step = max(csum_block, LANE_TILE)
+    while True:  # the eager compile-failure retry of the plain kernel
+        try:
+            return _apply_tiled_csum(
+                big, kb, data, c, r, s, pad, tile, csum_block,
+                interpret=interpret,
+            )
+        except Exception:
+            if s > 1:
+                s //= 2
+            elif tile > step:
+                tile = _pick_fused_tile(n, csum_block, tile - step)
+            else:
+                raise
+
+
+# -- shards form --------------------------------------------------------
+def fused_csum_shards_supported(
+    c: int, shape: tuple[int, ...], csum_block: int
+) -> bool:
+    return (
+        shards_supported(c, shape)
+        and 256 <= csum_block <= SHARDS_MAX_TILE
+        and csum_block & (csum_block - 1) == 0
+        and shape[-1] % csum_block == 0
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _shards_csum_fn(
+    mat_bytes: bytes, r8: int, c8: int, s: int, tile: int, cb: int,
+    interpret: bool,
+):
+    """Fused shards-form apply: the zero-waste shards kernel
+    (_shards_fn) with the CRC fold epilogue per stripe — parity lands
+    in R per-shard refs, csums in one [B, C+R, (N/cb)*32] accumulator,
+    neither inputs nor outputs ever stacked in HBM."""
+    bitmatrix = np.frombuffer(mat_bytes, np.uint8).reshape(r8, c8)
+    c, r = c8 // 8, r8 // 8
+    pad = (-c) % 4
+    f = c + pad
+    rp = -(-r // 4) * 4
+    groups = SHARDS_SB // s
+    big = _zw_matrix(bitmatrix, c, r, pad)
+    kb_np = _kb_cached(cb)
+    nb = tile // cb
+
+    def kernel(bmat_ref, kb_ref, *refs):
+        ins = refs[:c]
+        outs = refs[c : c + r]
+        csum_ref = refs[c + r]
+        t = ins[0].shape[1]
+        for g in range(groups):
+            planes = []
+            for si in range(s):
+                q = g * s + si
+                flat = jnp.concatenate(
+                    [ins[i][q : q + 1, :] for i in range(c)], axis=0
+                )
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad, t), jnp.uint8)], axis=0
+                    )
+                planes.append(unpack_bitplanes(flat, interpret))
+            bits = (
+                planes[0] if s == 1
+                else jnp.concatenate(planes, axis=1)
+            )
+            out8 = _matmul_pack(bmat_ref[:], bits, r, interpret)
+            for si in range(s):
+                q = g * s + si
+                tile_o = out8[:, si * t : (si + 1) * t]
+                for j in range(r):
+                    outs[j][q : q + 1, :] = tile_o[j : j + 1, :]
+                csum_ref[q] = _crc_fold_tile(
+                    planes[si], tile_o, kb_ref, c, f, r, rp, cb,
+                    interpret,
+                )
+
+    @jax.jit
+    def apply(bmat, kb, *shards):
+        b, n = shards[0].shape
+        outs = pl.pallas_call(
+            kernel,
+            grid=(b // SHARDS_SB, n // tile),
+            in_specs=[
+                pl.BlockSpec(big.shape, lambda i, ch: (0, 0)),
+                pl.BlockSpec(kb_np.shape, lambda i, ch: (0, 0, 0)),
+            ]
+            + [
+                pl.BlockSpec((SHARDS_SB, tile), lambda i, ch: (i, ch))
+                for _ in range(c)
+            ],
+            out_specs=[
+                pl.BlockSpec((SHARDS_SB, tile), lambda i, ch: (i, ch))
+                for _ in range(r)
+            ]
+            + [
+                pl.BlockSpec(
+                    (SHARDS_SB, c + r, nb * 32), lambda i, ch: (i, 0, ch)
+                )
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, n), jnp.uint8)
+                for _ in range(r)
+            ]
+            + [
+                jax.ShapeDtypeStruct(
+                    (b, c + r, (n // cb) * 32), jnp.int32
+                )
+            ],
+            interpret=interpret,
+        )(bmat, kb, *shards)
+        return list(outs[:r]) + [_csum_pack(outs[r], c, r, cb)]
+
+    return apply, big, kb_np
+
+
+def gf_encode_csum_bitplane_pallas_shards(
+    bitmatrix,
+    shards: list,
+    csum_block: int,
+    interpret: bool | None = None,
+) -> tuple[list, jax.Array]:
+    """Shards-form fused encode+checksum: c per-shard [..., N] arrays
+    in; (R per-shard parity arrays, [B, C+R, N//csum_block] uint32
+    zero-init csums) out. Callers gate with
+    ``fused_csum_shards_supported``."""
+    if interpret is None:
+        interpret = not on_tpu()
+    mat = np.ascontiguousarray(np.asarray(bitmatrix, dtype=np.uint8))
+    r8, c8 = mat.shape
+    lead = shards[0].shape[:-1]
+    n = shards[0].shape[-1]
+    if c8 != len(shards) * 8:
+        raise ValueError(
+            f"bitmatrix cols {c8} != shards*8 {len(shards) * 8}"
+        )
+    tile = _pick_fused_tile(n, csum_block, SHARDS_MAX_TILE)
+    s = _shards_lane_batch(tile)
+    key = (mat.tobytes(), r8, c8, s, tile, csum_block, interpret)
+    fn, big, kb = _shards_csum_fn(*key)
+    traced = any(isinstance(v, jax.core.Tracer) for v in shards)
+    if not traced:
+        big = _dev_cached(("zw-shards",) + key[:-1], big)
+        kb = _dev_cached(("kb", csum_block), kb)
+    b = int(np.prod(lead, initial=1))
+    r = r8 // 8
+    flat = [jnp.asarray(v).reshape(b, n) for v in shards]
+    outs = fn(big, kb, *flat)
+    parity = [o.reshape(lead + (n,)) for o in outs[:r]]
+    csums = outs[r].reshape(lead + (c8 // 8 + r, n // csum_block))
+    return parity, csums
+
+
 def gf_encode_bitplane_pallas(
     bitmatrix,
     data: jax.Array,

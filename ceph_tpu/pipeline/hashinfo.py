@@ -4,8 +4,16 @@ Mirrors osd/ECUtil.h:731-780: one cumulative crc32c per shard, seeded
 at -1 (0xFFFFFFFF), updated append-only as shards grow; persisted next
 to the object and checked by deep scrub (ECBackend.cc:1829-1869).
 
-The crc math itself rides the Checksummer family; appends batch through
-the device CRC kernel when large, host fallback when tiny.
+Two append paths, bit-identical by construction:
+
+- ``append``: raw bytes, routed through ``checksum.crc32c_stream``
+  (host native below the device threshold, device-batched fold
+  above) — the fallback tier.
+- ``append_block_csums``: seeds the cumulative hashes from the fused
+  encode+checksum kernel's ZERO-INIT per-block csums
+  (ops/pallas_encode.py) via crc range concatenation — the bytes are
+  hashed exactly once, on device, while they were resident for the
+  encode matmul; the host never touches them again.
 """
 
 from __future__ import annotations
@@ -14,7 +22,7 @@ import json
 
 import numpy as np
 
-from ceph_tpu.checksum.host import crc32c as crc32c_ref
+from ceph_tpu.checksum import crc32c_chain, crc32c_stream
 
 SEED = 0xFFFFFFFF
 
@@ -57,11 +65,41 @@ class HashInfo:
         if len(sizes) > 1:
             raise ValueError(f"unequal append sizes {sizes}")
         for shard, data in bufs.items():
-            self.cumulative_shard_hashes[shard] = crc32c_ref(
-                self.cumulative_shard_hashes[shard], data
+            self.cumulative_shard_hashes[shard] = crc32c_stream(
+                data, self.cumulative_shard_hashes[shard]
             )
         if sizes:
             self.total_chunk_size += sizes.pop()
+
+    def append_block_csums(
+        self,
+        old_size: int,
+        to_append: "dict[int, np.ndarray]",
+        block_bytes: int,
+    ) -> None:
+        """Extend shard crcs from kernel-produced ZERO-INIT per-block
+        crc32c values (the fused encode+csum output) instead of raw
+        bytes: cum' = A_block @ cum ⊕ crc_0(block), repeated — bit-
+        identical to ``append`` over the same bytes, with no second
+        pass over them. Same contiguity/equal-length contract."""
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"non-contiguous append: old_size={old_size}, "
+                f"have={self.total_chunk_size}"
+            )
+        blocks = {
+            shard: np.asarray(v).reshape(-1)
+            for shard, v in to_append.items()
+        }
+        sizes = {v.size for v in blocks.values()}
+        if len(sizes) > 1:
+            raise ValueError(f"unequal append sizes {sizes}")
+        for shard, csums in blocks.items():
+            self.cumulative_shard_hashes[shard] = crc32c_chain(
+                self.cumulative_shard_hashes[shard], csums, block_bytes
+            )
+        if sizes:
+            self.total_chunk_size += sizes.pop() * block_bytes
 
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]

@@ -13,7 +13,13 @@ message analog).
 Deep scrub mirrors ECBackend::be_deep_scrub (osd/ECBackend.cc:1769,
 CRC check :1829-1869): every shard's stored bytes are CRC32C'd from the
 seed and compared against the object's persisted ``HashInfo``; a
-mismatched shard is reported so recovery can rebuild it.
+mismatched shard is reported so recovery can rebuild it. The CRC rides
+``checksum.crc32c_stream`` — device-batched fold above the
+``csum_device_min_bytes`` threshold, host scalar below — so scrubbing
+a large object no longer serializes through the host hash. Recovery
+verifies fully reconstructed shards against the persisted HashInfo the
+same way (``ec_recovery_verify``) BEFORE pushing them: a miscomputed
+or bit-flipped rebuild can never silently replace a shard.
 """
 
 from __future__ import annotations
@@ -23,7 +29,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ceph_tpu.checksum.host import crc32c as crc32c_ref
+from ceph_tpu.checksum import crc32c_stream
 from ceph_tpu.store import Transaction
 
 from .extents import ExtentSet
@@ -272,6 +278,11 @@ class RecoveryBackend:
             return
         op.state = RecoveryState.WRITING
         hinfo = self.hinfo_fn(op.oid)
+        err = self._verify_reconstructed(op, hinfo)
+        if err is not None:
+            op.error = err
+            op.state = RecoveryState.COMPLETE
+            return
         hinfo_bytes = hinfo.to_bytes() if hinfo is not None else None
         # Every missing shard gets a push: zero-length tail shards
         # still carry the object (touch) and its hinfo attr, exactly
@@ -317,6 +328,42 @@ class RecoveryBackend:
             )
         if not op.pending_pushes:
             op.state = RecoveryState.COMPLETE
+
+    def _verify_reconstructed(
+        self, op: RecoveryOp, hinfo
+    ) -> "Exception | None":
+        """Check a FULL rebuild against the persisted cumulative shard
+        crcs before anything is pushed (be_deep_scrub applied to the
+        decode output, device-batched via crc32c_stream). Skipped for
+        delta recovery (partial extents can't reproduce a cumulative
+        hash) and for objects whose hashes were invalidated by an
+        overwrite — exactly the windows deep scrub skips too."""
+        from ceph_tpu.utils import config
+
+        if (
+            not config.get("ec_recovery_verify")
+            or hinfo is None
+            or op.extent_override is not None
+        ):
+            return None
+        hashed = hinfo.get_total_chunk_size()
+        if hashed == 0:
+            return None
+        for shard in sorted(op.missing):
+            if shard not in op.want:
+                continue  # zero-length tail shard: nothing rebuilt
+            # absent bytes read as zeros — the encode-time zero-pad
+            # convention the cumulative hashes were built under
+            got = crc32c_stream(
+                op.result.get(shard, 0, hashed), SEED
+            )
+            want = hinfo.get_chunk_hash(shard)
+            if got != want:
+                return IOError(
+                    f"reconstructed shard {shard} of {op.oid!r} fails "
+                    f"HashInfo verify: got {got:#x} want {want:#x}"
+                )
+        return None
 
     # -- log-driven delta recovery (PGLog missing-set replay) ----------
     def recover_from_log(self, pglog, shard: int) -> dict[str, RecoveryOp]:
@@ -438,7 +485,7 @@ def be_deep_scrub(
             # were hashed as zeros at encode time (zero-padding).
             if len(buf) < want_len:
                 buf = buf + b"\0" * (want_len - len(buf))
-            crc = crc32c_ref(crc, buf)
+            crc = crc32c_stream(buf, crc)
         if missing:
             continue
         want = hinfo.get_chunk_hash(shard)

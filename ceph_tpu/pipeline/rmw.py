@@ -351,6 +351,13 @@ class RMWPipeline:
         self.sinfo = sinfo
         self.codec = codec
         self.backend = backend
+        #: csum-block granularity for the fused encode+checksum path
+        #: (matches the stores' BlueStore-analog default); the encode
+        #: dispatch emits per-block crc32c for all k+m shards at this
+        #: granularity and sub-writes carry them to the stores
+        from ceph_tpu.utils import config as _config
+
+        self.csum_block = int(_config.get("csum_block_size"))
         if cache_lines is None:
             from ceph_tpu.utils import config
 
@@ -832,11 +839,14 @@ class RMWPipeline:
             if lo == hashed:
                 append_base = hashed
             if append_base is not None:
-                new_map.encode(self.codec, hinfo, old_size=append_base)
+                new_map.encode(
+                    self.codec, hinfo, old_size=append_base,
+                    csum_block=self.csum_block,
+                )
             else:
                 # not a contiguous append: cumulative crcs can't be
                 # extended — invalidate (deep scrub then skips them)
-                new_map.encode(self.codec)
+                new_map.encode(self.codec, csum_block=self.csum_block)
                 if hashed:
                     hinfo.clear()
 
@@ -896,7 +906,18 @@ class RMWPipeline:
                 if end <= start:
                     continue
                 buf = bytes(result.get(shard, start, end - start))
-                txn.write(op.oid, start, buf)
+                # fused-kernel csums ride the sub-write when they
+                # describe this exact range (block-aligned within the
+                # encode window) — the store adopts them instead of
+                # re-hashing the bytes it just received
+                blk = result.csums_for(shard, start, end - start)
+                if blk is not None:
+                    txn.write(
+                        op.oid, start, buf, csums=blk,
+                        csum_block=result.csums["block"],
+                    )
+                else:
+                    txn.write(op.oid, start, buf)
                 written.insert(shard, start, np.frombuffer(buf, np.uint8))
             self._stamp_identity(
                 txn, op.oid, shard, new_size,

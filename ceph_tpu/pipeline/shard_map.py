@@ -30,6 +30,11 @@ class ShardExtentMap:
     def __init__(self, sinfo: StripeInfo) -> None:
         self.sinfo = sinfo
         self._bufs: dict[int, list[tuple[int, np.ndarray]]] = {}
+        #: fused encode+csum output, set by ``encode`` when the kernel
+        #: served it: {"block": cb, "shards": {shard: (window_lo,
+        #: uint32[nblocks] ZERO-INIT per-block crc32c)}} — the blocks
+        #: cover each shard's encode window contiguously
+        self.csums: "dict | None" = None
 
     # -- buffer management --------------------------------------------
     def insert(self, shard: int, offset: int, data) -> None:
@@ -135,21 +140,54 @@ class ShardExtentMap:
                 padded[off - start : off - start + buf.size] = buf
                 self.insert(shard, start, padded)
 
+    def csums_for(
+        self, shard: int, offset: int, length: int
+    ) -> "np.ndarray | None":
+        """Kernel-produced ZERO-INIT per-block csums covering exactly
+        ``[offset, offset+length)`` of ``shard``, or None when the
+        fused encode didn't run / the range isn't block-aligned within
+        the csum window. What the sub-write generator attaches to each
+        store write so BlueStore-analog blob csums come from the
+        kernel, not a host re-hash."""
+        if self.csums is None:
+            return None
+        from .stripe import csum_block_range
+
+        entry = self.csums["shards"].get(shard)
+        if entry is None:
+            return None
+        wlo, vals = entry
+        rng = csum_block_range(
+            offset, length, wlo, int(vals.size), self.csums["block"]
+        )
+        if rng is None:
+            return None
+        return vals[rng[0] : rng[1]]
+
     # -- codec drivers -------------------------------------------------
     def _slice_window(self) -> tuple[int, int]:
         lo, hi = self.ro_range()
         return lo, hi
 
     def encode(self, codec, hashinfo: HashInfo | None = None,
-               old_size: int | None = None) -> None:
+               old_size: int | None = None,
+               csum_block: int | None = None) -> None:
         """Compute parity for every page-aligned slice covered by the
         data shards and insert it into this map (ECUtil.cc:487-511).
 
         One batched device dispatch per presence-signature, not one per
         slice. Updates ``hashinfo`` with the newly written shard tails
         when given (the encode-time HashInfo append, ECUtil.cc:521-534).
+
+        With ``csum_block`` set and the codec's fused encode+csum
+        kernel able to serve the geometry, the SAME dispatch also
+        emits per-csum-block crc32c for all k+m shards (recorded in
+        ``self.csums`` for the sub-write path to carry to the stores)
+        and the HashInfo append is seeded from those kernel csums via
+        crc chaining — the bytes are hashed exactly once, on device.
         """
         k, m = self.sinfo.k, self.sinfo.m
+        self.csums = None
         lo0, hi0 = self._slice_window()
         if hi0 <= lo0:
             return
@@ -171,11 +209,41 @@ class ShardExtentMap:
                 for r in range(k)
             ]
         )
-        parity = self._dispatch_encode(codec, data)
+        parity = csums = None
+        cb = csum_block
+        if (
+            cb
+            and cs % cb == 0
+            and lo % cb == 0
+            and hasattr(codec, "encode_chunks_with_csums")
+        ):
+            parity_map, csums = codec.encode_chunks_with_csums(
+                {i: data[i] for i in range(k)}, cb
+            )
+            if parity_map is not None:
+                parity = np.stack(
+                    [np.asarray(parity_map[k + j]) for j in range(m)]
+                )
+        if parity is None:
+            parity = self._dispatch_encode(codec, data)
         for j in range(m):
             self.insert(
                 self.sinfo.get_shard(k + j), lo, parity[j].reshape(-1)
             )
+        if csums is not None:
+            # [n_chunks, k+m, cs/cb] -> per shard the window's linear
+            # block sequence (chunk-major, matching the shard's byte
+            # stream at offsets lo + i*cb)
+            arr = np.asarray(csums)
+            self.csums = {
+                "block": cb,
+                "shards": {
+                    self.sinfo.get_shard(raw): (
+                        lo, np.ascontiguousarray(arr[:, raw, :]).reshape(-1)
+                    )
+                    for raw in range(k + m)
+                },
+            }
         if hashinfo is not None:
             # Appends must be contiguous and equal-length across shards
             # (the HashInfo contract): hash every shard's zero-padded
@@ -183,15 +251,36 @@ class ShardExtentMap:
             # aligned dispatch window — see comment above).
             base = lo0 if old_size is None else old_size
             if hi0 > base:
-                hashinfo.append(
-                    base,
-                    {
-                        self.sinfo.get_shard(raw): self.get(
-                            self.sinfo.get_shard(raw), base, hi0 - base
-                        )
-                        for raw in range(k + m)
-                    },
-                )
+                if (
+                    self.csums is not None
+                    and base >= lo
+                    and (base - lo) % cb == 0
+                    and (hi0 - base) % cb == 0
+                    and hi0 <= hi
+                ):
+                    # device-seeded: chain the kernel's zero-init
+                    # block csums into the cumulative shard hashes
+                    first, last = (base - lo) // cb, (hi0 - lo) // cb
+                    hashinfo.append_block_csums(
+                        base,
+                        {
+                            shard: vals[first:last]
+                            for shard, (_wlo, vals) in
+                            self.csums["shards"].items()
+                        },
+                        cb,
+                    )
+                else:
+                    hashinfo.append(
+                        base,
+                        {
+                            self.sinfo.get_shard(raw): self.get(
+                                self.sinfo.get_shard(raw), base,
+                                hi0 - base,
+                            )
+                            for raw in range(k + m)
+                        },
+                    )
 
     @staticmethod
     def _dispatch_encode(codec, data: np.ndarray) -> np.ndarray:

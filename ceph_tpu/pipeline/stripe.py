@@ -31,6 +31,31 @@ def align_page_prev(x: int) -> int:
     return (x // PAGE_SIZE) * PAGE_SIZE
 
 
+def csum_block_range(
+    offset: int,
+    length: int,
+    window_lo: int,
+    nblocks: int,
+    csum_block: int,
+) -> "tuple[int, int] | None":
+    """Block-index [first, last) of ``[offset, offset+length)`` within
+    a csum window starting at ``window_lo`` that holds ``nblocks``
+    blocks of ``csum_block`` bytes — or None unless the range is
+    exactly block-aligned and fully covered. The shared shape math
+    that lets fused-kernel csums travel with sub-writes: a store may
+    only adopt kernel csums for ranges they describe bit-for-bit."""
+    if length <= 0 or csum_block <= 0 or offset < window_lo:
+        return None
+    rel = offset - window_lo
+    if rel % csum_block or length % csum_block:
+        return None
+    first = rel // csum_block
+    last = first + length // csum_block
+    if last > nblocks:
+        return None
+    return first, last
+
+
 class StripeInfo:
     """Geometry of one EC pool: (k, m, stripe_width, chunk_mapping).
 

@@ -17,7 +17,15 @@ Mirrors BlueStore's structural shape (src/os/bluestore/BlueStore.cc):
 - **every blob carries a checksum**: crc32c per csum-block stored in
   the blob metadata and verified on every read (BlueStore::_verify_csum,
   BlueStore.cc:12878) — a flipped bit on the device surfaces as EIO,
-  never as silently corrupt data;
+  never as silently corrupt data. Blob csums come from TWO sources:
+  a WRITE op carrying fused encode+csum kernel output (Op.csums —
+  per-block crc32c computed on device while the bytes were resident
+  for the EC encode matmul) is adopted directly after a seed-shift
+  XOR, so the hot write path hashes nothing on the host; every other
+  write (unaligned ranges, partial tail blocks, non-EC callers)
+  falls back to the host scalar path behind the Checksummer facade
+  (checksum.crc32c_scalar). Read-side verification always recomputes
+  on the host facade — the store never trusts bytes it returns;
 - transactions follow the same validated-atomic contract as
   MemStore/FileStore: the SAME store test suite runs over all three
   backends (the store_test.cc pattern).
@@ -36,7 +44,8 @@ import json
 import os
 import threading
 
-from ceph_tpu.checksum.host import crc32c as _crc
+from ceph_tpu.checksum import crc32c_scalar as _crc
+from ceph_tpu.checksum import crc32c_seed_shift
 
 from . import framed_log
 from .allocator import ALLOCATORS, AllocError
@@ -337,7 +346,10 @@ class BlockStore:
             self._get(staged, op.oid, create=True)
         elif op.kind is OpKind.WRITE:
             onode = self._get(staged, op.oid, create=True)
-            self._write_range(onode, op.offset, op.data, freed, allocated)
+            self._write_range(
+                onode, op.offset, op.data, freed, allocated,
+                csums=op.csums, csum_block=op.csum_block,
+            )
             onode.size = max(onode.size, op.offset + len(op.data))
         elif op.kind is OpKind.ZERO:
             onode = self._get(staged, op.oid, create=True)
@@ -387,15 +399,34 @@ class BlockStore:
             del onode.attrs[op.name]
 
     def _write_range(
-        self, onode: _Onode, offset: int, data: bytes, freed, allocated
+        self, onode: _Onode, offset: int, data: bytes, freed, allocated,
+        csums=None, csum_block: int = 0,
     ) -> None:
         """COW block write: the touched blocks are rewritten to fresh
-        extents; partial head/tail blocks merge old content first."""
+        extents; partial head/tail blocks merge old content first.
+
+        ``csums``: optional kernel-produced ZERO-INIT per-block crc32c
+        of ``data`` (fused encode+csum). Adopted only when they
+        describe the stored blocks exactly — block-aligned offset and
+        length at this store's csum granularity, no boundary merge —
+        else the host facade re-hashes (partial tail blocks always
+        fall back: crc(partial) != crc(zero-padded block))."""
         if not data:
             return
         bs = self.block_size
         lo = (offset // bs) * bs
         hi = -(-(offset + len(data)) // bs) * bs
+        provided = None
+        if (
+            csums is not None
+            and csum_block == self.csum_block
+            and bs % self.csum_block == 0
+            and offset == lo
+            and offset + len(data) == hi
+            and len(csums) * self.csum_block == len(data)
+        ):
+            shift = self._csum_seed_shift()
+            provided = [int(v) ^ shift for v in csums]
         buf = bytearray(hi - lo)
         # Preserve surrounding bytes of PARTIALLY covered boundary
         # blocks only. A fully covered block is never read — so a
@@ -429,10 +460,15 @@ class BlockStore:
                 tail = self._blob_bytes(blob)[hi - boff :]
                 self._store_run(onode, hi, tail, allocated)
         pos = 0
+        cb = self.csum_block
         for dev_off, ln in extents:
             chunk = bytes(buf[pos : pos + ln])
             self._dev_write(dev_off, chunk)
-            self._store_blob(onode, lo + pos, dev_off, chunk)
+            self._store_blob(
+                onode, lo + pos, dev_off, chunk,
+                provided[pos // cb : (pos + ln) // cb]
+                if provided is not None else None,
+            )
             pos += ln
 
     def _store_run(self, onode, logical_off, data, allocated) -> None:
@@ -447,10 +483,23 @@ class BlockStore:
             self._store_blob(onode, logical_off + pos, dev_off, chunk)
             pos += ln
 
-    def _store_blob(self, onode, logical_off, dev_off, data) -> None:
+    def _store_blob(
+        self, onode, logical_off, dev_off, data, csums=None
+    ) -> None:
         onode.blobs[logical_off] = _Blob(
-            dev_off, len(data), self._csum(data)
+            dev_off, len(data),
+            list(csums) if csums is not None else self._csum(data),
         )
+
+    def _csum_seed_shift(self) -> int:
+        """crc(CSUM_SEED, B) = crc(0, B) ^ this, for any csum block —
+        converts the fused kernel's zero-init csums to this store's
+        seed with one XOR per block (no bytes re-hashed)."""
+        if not hasattr(self, "_seed_shift"):
+            self._seed_shift = crc32c_seed_shift(
+                self.csum_block, CSUM_SEED
+            )
+        return self._seed_shift
 
     def _blob_read_verified(
         self, blob: _Blob, rel_off: int, rel_len: int

@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 import struct
 
-from ceph_tpu.checksum.host import crc32c as _crc
+from ceph_tpu.checksum import crc32c_scalar as _crc
 
 HDR = struct.Struct("<II")
 

@@ -35,6 +35,13 @@ class Op:
     length: int = 0
     data: bytes = b""
     name: str = ""
+    #: optional kernel-produced ZERO-INIT per-block crc32c values for
+    #: WRITE ops (the fused encode+csum output riding the sub-write);
+    #: stores that keep blob csums may adopt them instead of
+    #: re-hashing, others ignore them. Advisory: they must describe
+    #: ``data`` exactly (csum_block-aligned offset and length).
+    csums: "tuple[int, ...] | None" = None
+    csum_block: int = 0
 
 
 @dataclass
@@ -47,10 +54,19 @@ class Transaction:
         self.ops.append(Op(OpKind.TOUCH, oid))
         return self
 
-    def write(self, oid: str, offset: int, data: bytes) -> "Transaction":
+    def write(
+        self, oid: str, offset: int, data: bytes,
+        csums=None, csum_block: int = 0,
+    ) -> "Transaction":
+        """``csums``/``csum_block``: optional zero-init per-block
+        crc32c of ``data`` from the fused encode+csum kernel — see
+        ``Op.csums``."""
         self.ops.append(
             Op(OpKind.WRITE, oid, offset=offset, length=len(data),
-               data=bytes(data))
+               data=bytes(data),
+               csums=tuple(int(v) for v in csums) if csums is not None
+               else None,
+               csum_block=int(csum_block) if csums is not None else 0)
         )
         return self
 
@@ -106,11 +122,15 @@ class Transaction:
         """Compact binary encoding for ECSubWrite payloads: version
         byte, op count, then per op kind/oid/offset/length/name/data
         with u32 length prefixes (the versioned encode/decode pattern
-        of src/os/Transaction.h)."""
+        of src/os/Transaction.h). Transactions carrying kernel csums
+        encode as v2 (each op appends csum_block + u32 csum list);
+        csum-free transactions stay byte-identical v1, so the frozen
+        golden payloads and mixed-version peers are both safe."""
         import struct
 
+        ver = 2 if any(op.csums is not None for op in self.ops) else 1
         out = bytearray()
-        out += struct.pack("<BI", 1, len(self.ops))
+        out += struct.pack("<BI", ver, len(self.ops))
         for op in self.ops:
             oid = op.oid.encode()
             name = op.name.encode()
@@ -122,6 +142,11 @@ class Transaction:
             out += name
             out += struct.pack("<I", len(op.data))
             out += op.data
+            if ver >= 2:
+                csums = op.csums or ()
+                out += struct.pack("<II", op.csum_block, len(csums))
+                for v in csums:
+                    out += struct.pack("<I", v)
         return bytes(out)
 
     @classmethod
@@ -142,7 +167,7 @@ class Transaction:
 
         kinds = list(OpKind)
         ver, count = struct.unpack("<BI", take(5))
-        if ver != 1:
+        if ver not in (1, 2):
             raise ValueError(f"unsupported transaction encoding v{ver}")
         txn = cls()
         for _ in range(count):
@@ -154,9 +179,19 @@ class Transaction:
             name = take(name_len).decode()
             (data_len,) = struct.unpack("<I", take(4))
             data = bytes(take(data_len))
+            csums, csum_block = None, 0
+            if ver >= 2:
+                csum_block, n_csums = struct.unpack("<II", take(8))
+                if n_csums:
+                    csums = struct.unpack(
+                        f"<{n_csums}I", take(4 * n_csums)
+                    )
+                else:
+                    csum_block = 0
             txn.ops.append(
                 Op(kinds[code], oid, offset=offset, length=length,
-                   data=data, name=name)
+                   data=data, name=name, csums=csums,
+                   csum_block=csum_block)
             )
         if pos != len(raw):
             raise ValueError(

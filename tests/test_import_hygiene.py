@@ -30,6 +30,37 @@ def test_import_initializes_no_backend():
     assert proc.returncode == 0, proc.stderr
 
 
+def test_no_host_crc_imports_outside_checksum():
+    """The host crc32c fallback lives BEHIND the Checksummer facade
+    (checksum.crc32c_scalar / crc32c_stream record which backend ran):
+    pipeline/store/msg code importing ``checksum.host`` directly would
+    let the ~0.5 GB/s host path silently creep back into hot paths the
+    fused encode+csum kernel just cleared. Only checksum/ itself (and
+    tests) may touch it."""
+    import os
+
+    import ceph_tpu
+
+    pkg_root = os.path.dirname(ceph_tpu.__file__)
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        rel = os.path.relpath(dirpath, pkg_root)
+        if rel == "checksum" or rel.startswith("checksum" + os.sep):
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            if "checksum.host" in src or "checksum import host" in src:
+                offenders.append(os.path.relpath(path, pkg_root))
+    assert not offenders, (
+        f"checksum.host imported outside checksum/: {offenders}; "
+        "route through ceph_tpu.checksum.crc32c_scalar/crc32c_stream"
+    )
+
+
 def test_admin_socket_first_use_still_works():
     # Lazy builtin registration must still expose the command table.
     proc = _run(
