@@ -57,7 +57,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         prog="ecbench", description=__doc__.splitlines()[0]
     )
     p.add_argument(
-        "workload", choices=["encode", "decode", "repair", "checksum"]
+        "workload",
+        choices=["encode", "decode", "repair", "checksum", "loadgen"],
     )
     p.add_argument(
         "--plugin", "-p", default=None,
@@ -89,6 +90,48 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--csum-block", type=int, default=4096,
                    help="checksum workload: csum block size in bytes")
     p.add_argument("--verbose", "-v", action="store_true")
+    lg = p.add_argument_group(
+        "loadgen", "live-cluster workload (radosbench analog): the "
+        "two-column contract reports wall seconds and client bytes "
+        "moved; the full JSON report goes to stderr"
+    )
+    lg.add_argument("--preset", default=None,
+                    help="canned spec (smoke/mixed/write-heavy/"
+                         "read-heavy); flags below override")
+    lg.add_argument("--mix", default=None,
+                    help='op mix, e.g. "seq_write=2,read=5,'
+                         'rmw_overwrite=1"')
+    lg.add_argument("--objects", type=int, default=None,
+                    help="working-set cap (max objects)")
+    lg.add_argument("--object-size", type=int, default=None)
+    lg.add_argument("--queue-depth", type=int, default=None,
+                    help="closed-loop workers (radosbench -t)")
+    lg.add_argument("--ops", type=int, default=None,
+                    help="total ops to run")
+    lg.add_argument("--warmup", type=int, default=None,
+                    help="leading ops excluded from the measurement")
+    lg.add_argument("--popularity", default=None,
+                    choices=["uniform", "zipfian"])
+    lg.add_argument("--zipf-theta", type=float, default=None)
+    lg.add_argument("--osds", type=int, default=6)
+    lg.add_argument("--pg-num", type=int, default=8)
+    lg.add_argument("--chunk-size", type=int, default=4096,
+                    help="per-shard chunk bytes on the OSDs")
+    lg.add_argument("--fault-at", type=int, default=0,
+                    help="kill an OSD once this many ops completed "
+                         "(0 = no fault)")
+    lg.add_argument("--revive-at", type=int, default=0,
+                    help="revive it at this op count (0 = at run end)")
+    lg.add_argument("--fault-osd", type=int, default=-1,
+                    help="kill victim (-1 = the least-primary OSD)")
+    lg.add_argument("--device-clock", action="store_true",
+                    help="report small-op p99 from the device clock "
+                         "(tunnel-RTT independent)")
+    lg.add_argument("--seed", type=int, default=0xEC)
+    lg.add_argument("--smoke", action="store_true",
+                    help="tiny deterministic end-to-end run (CI "
+                         "surface): smoke preset, 4 OSDs, one "
+                         "kill/revive cycle")
     return p.parse_args(argv)
 
 
@@ -118,6 +161,8 @@ def run(args: argparse.Namespace) -> tuple[float, float]:
 
     if args.workload == "checksum":
         return _run_checksum(args)
+    if args.workload == "loadgen":
+        return _run_loadgen(args)
 
     profile = {}
     for kv in args.parameter:
@@ -249,6 +294,101 @@ def _run_repair(args, codec) -> tuple[float, float]:
         if not (np.asarray(out[lost]) == originals[lost]).all():
             raise RuntimeError(f"chunk {lost} differs after repair")
     return elapsed, total_kib
+
+
+def _run_loadgen(args) -> tuple[float, float]:
+    """Live-cluster load generation (the radosbench workload): boot a
+    vstart-analog cluster, drive the spec, verify every op, print the
+    JSON report on stderr, and honor the two-column contract with
+    (wall seconds, client bytes moved / 1024)."""
+    import json
+
+    from ceph_tpu.loadgen import (
+        FaultEvent,
+        FaultSchedule,
+        LoadCluster,
+        WorkloadSpec,
+        parse_mix,
+        preset,
+        run_spec,
+    )
+
+    if args.smoke:
+        spec = preset(
+            "smoke", seed=args.seed,
+            device_clock=bool(args.device_clock),
+        )
+        osds, k, m, chunk = 5, 2, 1, 1024
+        fault_at = spec.total_ops // 3
+        revive_at = (2 * spec.total_ops) // 3
+        args.fault_osd = -1  # least-primary victim, resolved below
+    else:
+        kw: dict = {}
+        if args.mix is not None:
+            kw["mix"] = parse_mix(args.mix)
+        if args.objects is not None:
+            kw["max_objects"] = args.objects
+        if args.object_size is not None:
+            kw["object_size"] = args.object_size
+        if args.queue_depth is not None:
+            kw["queue_depth"] = args.queue_depth
+        if args.ops is not None:
+            kw["total_ops"] = args.ops
+        if args.warmup is not None:
+            kw["warmup_ops"] = args.warmup
+        if args.popularity is not None:
+            kw["popularity"] = args.popularity
+        if args.zipf_theta is not None:
+            kw["zipf_theta"] = args.zipf_theta
+        kw["seed"] = args.seed
+        kw["device_clock"] = bool(args.device_clock)
+        spec = (
+            preset(args.preset, **kw)
+            if args.preset else WorkloadSpec(**kw)
+        )
+        profile = {}
+        for pkv in args.parameter:
+            key, _, val = pkv.partition("=")
+            profile[key] = val
+        k = int(profile.get("k", "3"))
+        m = int(profile.get("m", "2"))
+        osds, chunk = args.osds, args.chunk_size
+        fault_at, revive_at = args.fault_at, args.revive_at
+    cluster = LoadCluster(
+        n_osds=osds, k=k, m=m,
+        pg_num=(args.pg_num if not args.smoke else 4),
+        chunk_size=chunk,
+    )
+    schedule = None
+    if fault_at:
+        victim = args.fault_osd
+        if victim == -1:
+            victim = cluster.least_primary_osd()
+        events = [
+            FaultEvent(at_op=fault_at, action="kill", osd=victim)
+        ]
+        if revive_at:
+            events.append(
+                FaultEvent(at_op=revive_at, action="revive",
+                           osd=victim)
+            )
+        schedule = FaultSchedule(events)
+    try:
+        report = run_spec(cluster, spec, schedule)
+        if not report.get("exactly_once"):
+            raise RuntimeError(
+                f"op accounting mismatch: issued {report['ops_in']} "
+                f"!= accounted {report['ops_accounted']}"
+            )
+        if report["verify_failures"]:
+            raise RuntimeError(
+                f"{report['verify_failures']} ops failed "
+                "content/checksum verification"
+            )
+    finally:
+        cluster.shutdown()
+    print(json.dumps(report, sort_keys=True), file=sys.stderr)
+    return report["duration_s"], report["bytes"] / 1024
 
 
 def _run_checksum(args) -> tuple[float, float]:

@@ -42,6 +42,25 @@ class NoPrimary(Exception):
     degraded to serve — the reference client would block forever)."""
 
 
+def _client_perf(name: str):
+    """Register the client-op counter set (Objecter.cc's
+    l_osdc_* slice: active/inflight, completed, resent, failed —
+    plus a verify_failed slot loadgen's content checks feed)."""
+    from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+    return (
+        PerfCountersBuilder(perf_collection, name)
+        .add_u64_gauge("op_inflight", "ops currently in flight")
+        .add_u64_counter("op_completed", "terminally successful ops")
+        .add_u64_counter("op_resend", "attempts resent (retry loop)")
+        .add_u64_counter("op_error", "terminally failed ops")
+        .add_u64_counter(
+            "verify_failed", "client-side content/csum mismatches"
+        )
+        .create_perf_counters()
+    )
+
+
 class Objecter:
     """Map-aware op targeting + resend. ``monitor`` provides the map
     (in-process monc); transport is the framed messenger."""
@@ -53,11 +72,21 @@ class Objecter:
         op_timeout: float = 30.0,
         backoff: float = 0.05,
         secret: bytes | None = None,
+        perf_name: str | None = None,
     ) -> None:
         self.monitor = monitor
         self.max_attempts = max_attempts
         self.op_timeout = op_timeout
         self.backoff = backoff
+        # client-side op counters (the objecter half of `perf dump`:
+        # the reference's l_osdc_op_active/op_resend family). Opt-in
+        # by name so ordinary clients stay registration-free; loadgen
+        # passes one so runs are observable from the admin socket /
+        # exporter like daemon-side ops.
+        self.perf = (
+            _client_perf(perf_name) if perf_name is not None else None
+        )
+        self._inflight = 0
         # cluster PSK (keyring role): all client connections sealed
         self.messenger = Messenger("client", secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
@@ -132,10 +161,28 @@ class Objecter:
         snap: int = 0,
     ) -> OSDOpReply:
         reqid = f"{self.client_id}.{next(self._reqs)}"
-        with tracer.span("client_op", op=op, pool=pool, oid=oid):
-            return self._submit_traced(
-                pool, oid, op, offset, length, data, name, snap, reqid
-            )
+        if self.perf is not None:
+            with self._lock:
+                self._inflight += 1
+                self.perf.set("op_inflight", self._inflight)
+        try:
+            with tracer.span("client_op", op=op, pool=pool, oid=oid):
+                reply = self._submit_traced(
+                    pool, oid, op, offset, length, data, name, snap,
+                    reqid,
+                )
+            if self.perf is not None:
+                self.perf.inc("op_completed")
+            return reply
+        except Exception:
+            if self.perf is not None:
+                self.perf.inc("op_error")
+            raise
+        finally:
+            if self.perf is not None:
+                with self._lock:
+                    self._inflight -= 1
+                    self.perf.set("op_inflight", self._inflight)
 
     def _submit_traced(
         self, pool, oid, op, offset, length, data, name, snap, reqid
@@ -148,6 +195,8 @@ class Objecter:
         for attempt in range(self.max_attempts):
             if attempt:
                 self.resends += 1
+                if self.perf is not None:
+                    self.perf.inc("op_resend")
                 time.sleep(self.backoff * (2 ** (attempt - 1)))
             osdmap = self.monitor.osdmap  # refresh before each attempt
             try:

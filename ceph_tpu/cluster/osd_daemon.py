@@ -474,6 +474,13 @@ class OSDDaemon:
         self._req_unverified: dict[str, set] = {}
         #: loc -> monotonic time of its last durability fan-out
         self._req_poll_at: dict[str, float] = {}
+        #: async durability fan-outs (_take_or_spawn_poll): results
+        #: awaiting consumption, locs with a poller running, and the
+        #: daemon-wide budget bounding concurrent poller threads
+        self._req_poll_results: dict[str, tuple] = {}
+        self._req_polls_inflight: set[str] = set()
+        self._req_poll_lock = threading.Lock()
+        self._req_poll_sem = threading.Semaphore(self.REQ_POLL_BUDGET)
         #: queued reqid-cache invalidations from _kick_peering /
         #: pool deletion, applied under _op_lock by the next client
         #: op (_drain_req_flushes). _kick_peering cannot take
@@ -1797,18 +1804,18 @@ class OSDDaemon:
                 if hit is not None:
                     unv = self._req_unverified.get(msg.oid)
                     if unv and msg.reqid in unv:
-                        import time as _time
-
-                        now = _time.monotonic()
-                        if (
-                            now - self._req_poll_at.get(msg.oid, 0.0)
-                            < self.REQ_POLL_COOLDOWN
-                        ):
+                        # async fan-out: a cached verdict resolves
+                        # NOW; otherwise a poller thread is working
+                        # (or cooldown/budget defers one) and the op
+                        # parks in the client's retry loop — eagain,
+                        # never a multi-second wait on the op worker
+                        polled = self._take_or_spawn_poll(
+                            pg0, msg.oid
+                        )
+                        if polled is None:
                             return OSDOpReply(
                                 msg.tid, epoch, error="eagain"
                             )
-                        self._req_poll_at[msg.oid] = now
-                        polled = self._poll_req_state(pg0, msg.oid)
                         members = sum(
                             1 for o in pg0.acting if o != SHARD_NONE
                         )
@@ -1848,7 +1855,8 @@ class OSDDaemon:
                         t for t in self._req_window(pg0, msg.oid)
                         if t[0] != msg.reqid
                     ]
-                    unv.discard(msg.reqid)
+                    if unv:
+                        unv.discard(msg.reqid)
                     if msg.op == "append":
                         msg.op = "write"
                         msg.offset = max(hit[1] - len(msg.data), 0)
@@ -1954,16 +1962,23 @@ class OSDDaemon:
             self._req_windows.clear()
             self._req_unverified.clear()
             self._req_poll_at.clear()
+            with self._req_poll_lock:
+                # a verdict polled in the flushed interval must not
+                # judge a window re-seeded in the new one
+                self._req_poll_results.clear()
             return
         from ceph_tpu.placement import stable_hash
 
         pools = {e[1] for e in pending if e[0] == "pool"}
         pgs = {(e[1], e[3]): e[2] for e in pending if e[0] == "pg"}
         doomed = []
+        with self._req_poll_lock:
+            poll_locs = set(self._req_poll_results)
         for loc in (
             self._req_windows.keys()
             | self._req_unverified.keys()
             | self._req_poll_at.keys()
+            | poll_locs
         ):
             try:
                 pool_id, oid = split_loc(loc)
@@ -1983,6 +1998,8 @@ class OSDDaemon:
             self._req_windows.pop(loc, None)
             self._req_unverified.pop(loc, None)
             self._req_poll_at.pop(loc, None)
+            with self._req_poll_lock:
+                self._req_poll_results.pop(loc, None)
 
     def _req_window(self, pg: _PG, loc: str) -> list:
         """This object's reqid window, seeding from the stored attr
@@ -2010,12 +2027,69 @@ class OSDDaemon:
         return win
 
     #: deadline for the one-shot durability fan-out (rare failover
-    #: path, but it runs under _op_lock — a full RPC timeout per
-    #: member would stall every client op on the daemon)
+    #: path; it runs on its OWN thread — never under _op_lock, never
+    #: on the op worker — so it cannot stall unrelated client ops)
     REQ_POLL_TIMEOUT = 2.5
-    #: minimum spacing between fan-outs for the SAME unsettled object
-    #: (client retries answer eagain from the cooldown, not a re-poll)
+    #: minimum spacing between fan-out STARTS for the SAME unsettled
+    #: object (client retries answer eagain; a finished poll's cached
+    #: verdict is consumed regardless of the cooldown)
     REQ_POLL_COOLDOWN = 1.0
+    #: daemon-wide cap on concurrent fan-out threads: an adversarial
+    #: burst of torn objects must not spawn unbounded pollers — ops
+    #: past the budget answer eagain and retry into a free slot
+    REQ_POLL_BUDGET = 2
+
+    def _take_or_spawn_poll(self, pg: _PG, loc: str):
+        """PARK-AND-RE-ENTER for the durability fan-out (ADVICE r5
+        osd_daemon:1912: the 2.5 s fan-out used to run under _op_lock
+        ON the single op worker, so a handful of torn objects
+        serialized multi-second stalls onto every client op).
+
+        Returns a finished poll's ``(windows, infos)`` if one is
+        cached for this object, else starts one on a dedicated
+        thread (cooldown- and budget-gated) and returns None — the
+        caller answers eagain, the client's retry loop re-enters,
+        and a later attempt consumes the verdict synchronously. The
+        op worker never blocks. Caller holds _op_lock."""
+        with self._req_poll_lock:
+            res = self._req_poll_results.pop(loc, None)
+            if res is not None:
+                return res
+            if loc in self._req_polls_inflight:
+                return None  # fan-out already running: retry later
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._req_poll_at.get(loc, 0.0) < self.REQ_POLL_COOLDOWN:
+            return None
+        if not self._req_poll_sem.acquire(blocking=False):
+            return None  # budget exhausted: eagain, retry into a slot
+        self._req_poll_at[loc] = now
+        with self._req_poll_lock:
+            self._req_polls_inflight.add(loc)
+
+        def run() -> None:
+            try:
+                polled = self._poll_req_state(pg, loc)
+            except Exception:
+                polled = ([], [])  # classify from nothing -> back off
+            finally:
+                self._req_poll_sem.release()
+            with self._req_poll_lock:
+                self._req_polls_inflight.discard(loc)
+                self._req_poll_results[loc] = polled
+                while len(self._req_poll_results) > 256:
+                    # an abandoned verdict (client gave up) must not
+                    # accumulate forever
+                    self._req_poll_results.pop(
+                        next(iter(self._req_poll_results))
+                    )
+
+        threading.Thread(
+            target=run, daemon=True,
+            name=f"osd.{self.osd_id}-req-poll",
+        ).start()
+        return None
 
     def _poll_req_state(self, pg: _PG, loc: str):
         """ONE async fan-out to the acting members for the object's
@@ -2152,21 +2226,19 @@ class OSDDaemon:
         unv = self._req_unverified.get(loc)
         if not unv:
             return True
-        # throttle: an unsettled object re-polled on every client
-        # retry held _op_lock for the full fan-out deadline each time
-        # and starved heartbeats under churn — within the cooldown,
-        # answer eagain from the last verdict instead of re-polling
-        import time as _time
-
-        now = _time.monotonic()
-        last = self._req_poll_at.get(loc, 0.0)
-        if polled is None and now - last < self.REQ_POLL_COOLDOWN:
-            return False
-        self._req_poll_at[loc] = now
-        windows, infos = (
-            polled if polled is not None
-            else self._poll_req_state(pg, loc)
-        )
+        if polled is not None:
+            windows, infos = polled
+        else:
+            # async fan-out (cooldown + budget inside): no verdict
+            # ready yet -> eagain; the client's retry re-enters and
+            # consumes it once the poller thread finishes. The old
+            # synchronous poll held _op_lock for the full 2.5 s
+            # deadline and several torn objects serialized that stall
+            # onto every client op (ADVICE r5).
+            res = self._take_or_spawn_poll(pg, loc)
+            if res is None:
+                return False
+            windows, infos = res
         k = pg.rmw.sinfo.k
         members = sum(1 for o in pg.acting if o != SHARD_NONE)
         unanswered = max(members - len(windows), 0)
@@ -2865,6 +2937,41 @@ class OSDDaemon:
             ]
         for pg in stuck:
             self._kick_peering(pg)
+        # a failed shard catch-up reverts the member to a hole
+        # (_catch_up_shard's except path) — with no further map
+        # epoch, nothing would ever retry and the PG stays degraded
+        # forever on a settled cluster. The tick re-heals: any shard
+        # the CURRENT map says is up but my acting view holds as a
+        # hole goes back through the recovering -> catch-up pipeline.
+        to_heal: list[tuple[_PG, int]] = []
+        with self._pg_lock:
+            for (pool, pgid), pg in self._pgs.items():
+                if first_live(pg.acting) != self.osd_id:
+                    continue
+                if pool not in self.osdmap.pools or pg.backfilling:
+                    continue
+                if self.osdmap.pg_to_raw(pool, pgid) != pg.raw:
+                    continue  # layout moved: backfill's problem
+                map_acting = self.osdmap.pg_to_up_acting(pool, pgid)
+                for i, osd in enumerate(map_acting):
+                    if (
+                        osd != SHARD_NONE
+                        and pg.acting[i] == SHARD_NONE
+                        and i not in pg.backend.recovering
+                    ):
+                        pg.acting[i] = osd
+                        pg.backend.acting[i] = osd
+                        pg.backend.recovering.add(i)
+                        to_heal.append((pg, i))
+        for pg, shard in to_heal:
+            self.log.info(
+                "pg", f"{pg.pool}/{pg.pgid}:", "re-healing shard",
+                shard, "(previous catch-up failed)"
+            )
+            threading.Thread(
+                target=self._catch_up_shard, args=(pg, shard),
+                daemon=True,
+            ).start()
 
     # -- background scrub scheduler (osd/scrubber/osd_scrub.cc role) ----
     def _scrub_due(

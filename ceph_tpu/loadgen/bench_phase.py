@@ -1,0 +1,90 @@
+"""The BENCH json ``cluster`` phase: what the LIVE TIER gives back.
+
+Every other bench phase clocks a kernel or a codec dispatch; this one
+boots the real mini-cluster (mon + socket OSDs + device codecs +
+stores), drives a mixed workload with a mid-run OSD kill/revive, and
+reports the end-to-end service numbers next to the kernel ones:
+
+- ``cluster_gbps`` / ``cluster_iops``   measured-window aggregate
+- ``cluster_p99_ms``                    small-op p99 from the DEVICE
+  clock (host floor replaced by the trip-count-differenced device op
+  time — tunnel-RTT independent, no ``latency_degraded`` flag needed;
+  ``cluster_p99_host_ms`` keeps the raw host row for comparison)
+- ``cluster_degraded_gbps`` / ``cluster_degraded_window_s`` /
+  ``cluster_time_to_recovered_s``       the fault-schedule cut
+- ``cluster_vs_kernel_frac``            cluster_gbps over the flagship
+  kernel encode rate — the tax the whole service stack levies on the
+  raw codec (client, sockets, daemon locks, store writes, checksums)
+
+Sized by ``CEPH_TPU_BENCH_CLUSTER_OPS`` (default 240 ops over 48
+256-KiB objects at queue depth 12 — a few-minute phase through a
+degraded tunnel, seconds locally)."""
+
+from __future__ import annotations
+
+import os
+
+from .cluster import LoadCluster
+from .driver import run_spec
+from .faults import FaultEvent, FaultSchedule
+from .spec import WorkloadSpec
+
+
+def measure_cluster(result: dict, enc_gbps: float) -> None:
+    total_ops = int(
+        os.environ.get("CEPH_TPU_BENCH_CLUSTER_OPS", "240")
+    )
+    cluster = LoadCluster(
+        n_osds=6, k=4, m=2, pg_num=8, chunk_size=16384,
+    )
+    try:
+        spec = WorkloadSpec(
+            mix={
+                "seq_write": 2, "rand_write": 1, "read": 3,
+                "reconstruct_read": 1, "rmw_overwrite": 1,
+            },
+            object_size=256 * 1024,
+            max_objects=48,
+            queue_depth=12,
+            total_ops=total_ops,
+            warmup_ops=max(total_ops // 10, 8),
+            popularity="zipfian",
+            device_clock=True,
+        )
+        faults = FaultSchedule(
+            [
+                FaultEvent(at_op=total_ops // 3, action="kill"),
+                FaultEvent(at_op=(2 * total_ops) // 3,
+                           action="revive"),
+            ]
+        )
+        report = run_spec(cluster, spec, faults)
+    finally:
+        cluster.shutdown()
+
+    result["cluster_gbps"] = report["gbps"]
+    result["cluster_iops"] = report["iops"]
+    if "lat_p99_ms" in report:
+        result["cluster_p99_host_ms"] = report["lat_p99_ms"]
+        # device-clock p99 when the probe succeeded (VERDICT weak #6:
+        # the host row measures the tunnel when RTT is degraded)
+        result["cluster_p99_ms"] = report.get(
+            "lat_p99_ms_device", report["lat_p99_ms"]
+        )
+    fault = report.get("fault", {})
+    for key in (
+        "degraded_gbps", "degraded_window_s", "time_to_recovered_s"
+    ):
+        if key in fault:
+            result[f"cluster_{key}"] = fault[key]
+    result["cluster_verify_failures"] = report["verify_failures"]
+    result["cluster_errors"] = report["errors"]
+    result["cluster_recovered"] = bool(report.get("recovered"))
+    if enc_gbps:
+        # the kernel-vs-cluster efficiency ratio: how much of the raw
+        # codec rate survives the full service path (tiny by design
+        # today — this row exists to be watched, 8 decimals so a
+        # Python-socket-tier number doesn't round to zero)
+        result["cluster_vs_kernel_frac"] = round(
+            report["gbps"] / enc_gbps, 8
+        )

@@ -1,0 +1,406 @@
+"""Closed-loop multi-threaded load driver — the radosbench analog.
+
+N workers (= queue depth) each keep exactly one op in flight through
+the librados-style client against a live cluster: real sockets, the
+map-aware objecter retry loop, device codecs on the primaries, real
+stores. Every op is verified (content byte-equality AND a crc32c
+check of got-vs-expected) and lands in exactly one ledger slot
+(``ops_accounted == ops issued`` at exit — the exactly-once check).
+
+Op classes (spec.mix):
+
+- ``seq_write``        full-object write of the next sequential oid
+                       (wraps to a version bump once max_objects live)
+- ``rand_write``       full-object rewrite of a popular existing oid
+- ``read``             full read + verify of a popular existing oid
+- ``reconstruct_read`` read targeted at an object whose acting set
+                       currently has a dead member — a true degraded/
+                       reconstruct read while the fault schedule has
+                       an OSD down, accounted as plain ``read`` when
+                       the cluster is whole (``reclassified`` counts
+                       them; a mix can't fake degraded coverage)
+- ``rmw_overwrite``    sub-stripe patch at a derived offset (the
+                       parity-delta RMW path), expected image replayed
+                       from the deterministic patch chain
+
+Object contents are pure functions of (spec.seed, object, version,
+patch chain) — verification regenerates, nothing is remembered, so
+the working set can exceed client memory.
+
+Client-side observability: the objecter's ``loadgen_client`` perf
+counters (inflight/completed/retried) are live during the run and the
+driver adds verify-failure and per-class counters to the same set —
+``admin_socket execute("perf dump")`` or the Prometheus exporter can
+watch a run from outside, like daemon-side ops."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.checksum import crc32c_scalar
+from ceph_tpu.cluster.osdmap import SHARD_NONE
+
+from .faults import FaultSchedule
+from .recorder import DeviceClock, RunRecorder
+from .spec import (
+    Popularity,
+    WorkloadSpec,
+    expected_image,
+    object_bytes,
+    patch_bytes,
+)
+
+
+@dataclass
+class _ObjState:
+    version: int = 1
+    n_patches: int = 0
+    #: first write landed — readers/overwriters only pick published
+    #: objects (state is allocated BEFORE the create write completes,
+    #: and a concurrent reader could win the object lock first)
+    exists: bool = False
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class LoadGenerator:
+    """Run a WorkloadSpec against a LoadCluster."""
+
+    def __init__(
+        self,
+        cluster,
+        spec: WorkloadSpec,
+        fault_schedule: FaultSchedule | None = None,
+    ) -> None:
+        self.cluster = cluster
+        self.spec = spec
+        self.faults = fault_schedule
+        self.recorder = RunRecorder(warmup_ops=spec.warmup_ops)
+        self._op_seq = 0
+        self._ops_done = 0
+        self._seq_next = 0
+        self._objects: dict[int, _ObjState] = {}
+        self._obj_lock = threading.Lock()
+        self._pick = Popularity(spec)
+        self._stop = threading.Event()
+        self._errors: list[str] = []
+        #: (oid, version, n_patches, got_len, first_diff) per verify
+        #: failure — the forensic trail a red run is debugged from
+        self.verify_detail: list[tuple] = []
+        self.reclassified = 0  # reconstruct_read served while whole
+        self._class_names = sorted(spec.mix)
+        self._weights = np.array(
+            [spec.mix[c] for c in self._class_names], float
+        )
+        self._weights /= self._weights.sum()
+        #: the objecter's client counter set (inflight/completed/
+        #: resend/verify_failed) — None for perf-less clients
+        self._pc = getattr(
+            self.cluster.client.objecter, "perf", None
+        )
+        self._class_pc = self._build_class_perf()
+
+    def _build_class_perf(self):
+        """Per-class completion counters + one latency histogram in
+        the process perf collection (`perf dump` / exporter surface,
+        updated live per op)."""
+        from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+        from .histogram import Log2Histogram
+        from .spec import OP_CLASSES
+
+        b = PerfCountersBuilder(perf_collection, "loadgen")
+        for cls in OP_CLASSES:
+            b.add_u64_counter(f"ops_{cls}", f"completed {cls} ops")
+        bounds, _ = Log2Histogram().perf_buckets()
+        b.add_histogram(
+            "op_latency", bounds, "op latency (seconds, log2)"
+        )
+        return b.create_perf_counters()
+
+    # -- op bookkeeping -------------------------------------------------
+    def _next_op(self) -> int | None:
+        """Claim the next global op number, or None when done."""
+        with self._obj_lock:
+            if self._op_seq >= self.spec.total_ops:
+                return None
+            self._op_seq += 1
+            return self._op_seq
+
+    def _obj(self, idx: int) -> _ObjState:
+        with self._obj_lock:
+            st = self._objects.get(idx)
+            if st is None:
+                st = self._objects[idx] = _ObjState()
+            return st
+
+    def _live_indices(self) -> list[int]:
+        with self._obj_lock:
+            return sorted(
+                i for i, st in self._objects.items() if st.exists
+            )
+
+    def _oid(self, idx: int) -> str:
+        return f"lg-{self.spec.seed:x}-{idx}"
+
+    # -- verification ---------------------------------------------------
+    def _verify(self, idx: int, got: bytes, version: int,
+                n_patches: int) -> bool:
+        want = expected_image(
+            self.spec.seed, idx, version, n_patches,
+            self.spec.object_size, self.spec.rmw_max_len,
+        )
+        # checksum first (the cheap deep-scrub-style check), then the
+        # definitive byte comparison — both must agree
+        if crc32c_scalar(0xFFFFFFFF, got) == crc32c_scalar(
+            0xFFFFFFFF, want
+        ) and got == want:
+            return True
+        diff = next(
+            (i for i, (a, b) in enumerate(zip(got, want)) if a != b),
+            min(len(got), len(want)),
+        )
+        try:  # placement snapshot: which members served this read
+            acting = self.cluster.mon.osdmap.object_to_acting(
+                self.cluster.pool, self._oid(idx)
+            )
+        except Exception:
+            acting = []
+        self.verify_detail.append(
+            (self._oid(idx), version, n_patches, len(got), diff,
+             list(acting), list(self.cluster.dead),
+             got[:24].hex())
+        )
+        return False
+
+    def _degraded_target(self, rng: np.random.Generator) -> int | None:
+        """An existing object whose acting set has a dead member —
+        reading it forces shard reconstruction."""
+        live = self._live_indices()
+        if not live:
+            return None
+        osdmap = self.cluster.mon.osdmap
+        start = int(rng.integers(0, len(live)))
+        for off in range(len(live)):
+            idx = live[(start + off) % len(live)]
+            acting = osdmap.object_to_acting(
+                self.cluster.pool, self._oid(idx)
+            )
+            if any(o == SHARD_NONE for o in acting):
+                return idx
+        return None
+
+    # -- op implementations ---------------------------------------------
+    def _op_seq_write(self, rng) -> tuple[str, int]:
+        with self._obj_lock:
+            if self._seq_next < self.spec.max_objects:
+                idx = self._seq_next
+                self._seq_next += 1
+            else:
+                idx = None
+        if idx is None:  # working set full: wrap onto a rewrite
+            return self._op_rand_write(rng)
+        st = self._obj(idx)
+        with st.lock:
+            data = object_bytes(
+                self.spec.seed, idx, st.version, self.spec.object_size
+            )
+            try:
+                size = self.cluster.io.write_full(
+                    self._oid(idx), data
+                )
+            except Exception:
+                # outcome unknown (op may or may not have applied):
+                # quarantine — the model can no longer predict this
+                # object's bytes, so no later op may verify against it
+                st.exists = False
+                raise
+            ok = size == len(data)
+            st.exists = st.exists or ok
+        return ("seq_write" if ok else "error"), len(data)
+
+    def _op_rand_write(self, rng) -> tuple[str, int]:
+        live = self._live_indices()
+        if not live:
+            return self._op_seq_write(rng)
+        idx = live[self._pick.pick(rng, len(live)) % len(live)]
+        st = self._obj(idx)
+        with st.lock:
+            st.version += 1
+            st.n_patches = 0
+            data = object_bytes(
+                self.spec.seed, idx, st.version, self.spec.object_size
+            )
+            try:
+                size = self.cluster.io.write_full(
+                    self._oid(idx), data
+                )
+            except Exception:
+                st.exists = False  # unknown outcome: quarantine
+                raise
+            ok = size == len(data)
+        return ("rand_write" if ok else "error"), len(data)
+
+    def _op_read(self, rng, want_degraded: bool = False
+                 ) -> tuple[str, int]:
+        idx = None
+        cls = "read"
+        if want_degraded:
+            idx = self._degraded_target(rng)
+            if idx is not None:
+                cls = "reconstruct_read"
+            else:
+                self.reclassified += 1
+        if idx is None:
+            live = self._live_indices()
+            if not live:
+                return self._op_seq_write(rng)
+            idx = live[self._pick.pick(rng, len(live)) % len(live)]
+        st = self._obj(idx)
+        with st.lock:
+            got = self.cluster.io.read(self._oid(idx))
+            good = self._verify(idx, got, st.version, st.n_patches)
+        if not good:
+            self._pc_inc("verify_failed")
+            return "verify_failed:" + cls, len(got)
+        return cls, len(got)
+
+    def _op_rmw_overwrite(self, rng) -> tuple[str, int]:
+        live = self._live_indices()
+        if not live:
+            return self._op_seq_write(rng)
+        idx = live[self._pick.pick(rng, len(live)) % len(live)]
+        st = self._obj(idx)
+        with st.lock:
+            patch_no = st.n_patches + 1
+            off, payload = patch_bytes(
+                self.spec.seed, idx, st.version, patch_no,
+                self.spec.object_size, self.spec.rmw_max_len,
+            )
+            try:
+                self.cluster.io.write(
+                    self._oid(idx), payload, offset=off
+                )
+            except Exception:
+                st.exists = False  # unknown outcome: quarantine
+                raise
+            st.n_patches = patch_no
+        return "rmw_overwrite", len(payload)
+
+    def _pc_inc(self, key: str) -> None:
+        if self._pc is not None:
+            self._pc.inc(key)
+
+    # -- the worker loop ------------------------------------------------
+    def _worker(self, wid: int) -> None:
+        rng = np.random.default_rng(
+            [self.spec.seed & 0x7FFFFFFF, 0x40B, wid]
+        )
+        impls = {
+            "seq_write": self._op_seq_write,
+            "rand_write": self._op_rand_write,
+            "read": lambda r: self._op_read(r, want_degraded=False),
+            "reconstruct_read": lambda r: self._op_read(
+                r, want_degraded=True
+            ),
+            "rmw_overwrite": self._op_rmw_overwrite,
+        }
+        while not self._stop.is_set():
+            opno = self._next_op()
+            if opno is None:
+                return
+            req = self._class_names[
+                int(rng.choice(len(self._class_names), p=self._weights))
+            ]
+            t0 = time.monotonic()
+            try:
+                cls, nbytes = impls[req](rng)
+            except Exception as e:
+                lat = time.monotonic() - t0
+                self.recorder.record(req, lat, 0, ok=False)
+                self._errors.append(f"{req}: {type(e).__name__}: {e}")
+                self._after_op()
+                continue
+            lat = time.monotonic() - t0
+            if cls.startswith("verify_failed:"):
+                self.recorder.record(
+                    cls.split(":", 1)[1], lat, nbytes,
+                    ok=False, verify_failed=True,
+                )
+            elif cls == "error":
+                self.recorder.record(req, lat, nbytes, ok=False)
+            else:
+                self.recorder.record(cls, lat, nbytes)
+                self._class_pc.inc(f"ops_{cls}")
+                self._class_pc.hinc("op_latency", lat)
+            self._after_op()
+
+    def _after_op(self) -> None:
+        with self._obj_lock:
+            self._ops_done += 1
+            done = self._ops_done
+        if self.faults is not None:
+            try:
+                self.faults.maybe_fire(done, self.cluster)
+            except Exception as e:  # a broken thrash must surface
+                self._errors.append(
+                    f"fault: {type(e).__name__}: {e}"
+                )
+                self._stop.set()
+
+    # -- entry point ----------------------------------------------------
+    def run(self) -> dict:
+        """Execute the spec; returns the full run report."""
+        if self.spec.device_clock:
+            codec = self.cluster.codec()
+            self.recorder.device_floor_s = DeviceClock.measure(
+                codec, codec.get_chunk_size(self.spec.object_size)
+            )
+        threads = [
+            threading.Thread(
+                target=self._worker, args=(w,), daemon=True,
+                name=f"loadgen-w{w}",
+            )
+            for w in range(self.spec.queue_depth)
+        ]
+        self.recorder.t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.recorder.finish()
+        if self.faults is not None:
+            self.faults.settle(self.cluster)
+        report = self.recorder.report()
+        report["ops_in"] = self._op_seq
+        report["reclassified_reads"] = self.reclassified
+        with self._obj_lock:
+            # objects whose write outcome is unknown (quarantined:
+            # excluded from verification-bearing ops)
+            report["quarantined_objects"] = sum(
+                1 for st in self._objects.values() if not st.exists
+            )
+        report["exactly_once"] = (
+            report["ops_in"] == report["ops_accounted"]
+        )
+        if self._errors:
+            report["error_samples"] = self._errors[:10]
+        if self.verify_detail:
+            report["verify_detail"] = [
+                list(t) for t in self.verify_detail[:10]
+            ]
+        if self.faults is not None:
+            report["fault"] = self.faults.metrics(self.recorder)
+            report["recovered"] = self.cluster.is_recovered()
+        return report
+
+
+def run_spec(
+    cluster, spec: WorkloadSpec,
+    fault_schedule: FaultSchedule | None = None,
+) -> dict:
+    """Convenience: drive ``spec`` on ``cluster`` and report."""
+    return LoadGenerator(cluster, spec, fault_schedule).run()

@@ -1,0 +1,111 @@
+"""Fault schedule — the thrasher hook (qa/tasks/ceph_manager.py
+kill/revive collapsed to deterministic op-offset triggers).
+
+A schedule is an ordered list of events pinned to completed-op
+offsets. The driver fires due events inline from whichever worker
+crosses the offset (single-fire under a lock), so a run with the same
+spec + schedule replays the same interleaving class-for-class. The
+schedule also keeps the timestamps the degraded-window metrics are
+cut from: kill time, revive time, and time-to-recovered (revive ->
+cluster reports every PG peered, no member missing, no catch-up or
+backfill in flight)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FaultEvent:
+    #: fire once the run's completed-op counter reaches this
+    at_op: int
+    #: "kill" | "revive"
+    action: str
+    #: target osd id; None = pick (kill: first live non-mon victim
+    #: in id order for determinism; revive: oldest corpse)
+    osd: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.action not in ("kill", "revive"):
+            raise ValueError(f"unknown fault action {self.action!r}")
+
+
+@dataclass
+class FaultSchedule:
+    events: list[FaultEvent] = field(default_factory=list)
+    #: bound on the post-revive recovery wait (seconds)
+    recovery_timeout: float = 60.0
+
+    def __post_init__(self) -> None:
+        self.events = sorted(self.events, key=lambda e: e.at_op)
+        self._lock = threading.Lock()
+        self._next = 0
+        self.kill_at: float | None = None      # monotonic stamps
+        self.revive_at: float | None = None
+        self.recovered_at: float | None = None
+        self.killed: list[int] = []
+
+    def maybe_fire(self, ops_done: int, cluster) -> None:
+        """Fire every event whose offset has been reached. Called on
+        the op path — must be cheap when nothing is due."""
+        if self._next >= len(self.events):
+            return
+        with self._lock:
+            while (
+                self._next < len(self.events)
+                and self.events[self._next].at_op <= ops_done
+            ):
+                ev = self.events[self._next]
+                self._next += 1
+                self._apply(ev, cluster)
+
+    def _apply(self, ev: FaultEvent, cluster) -> None:
+        if ev.action == "kill":
+            osd = ev.osd
+            if osd is None:
+                live = sorted(cluster.live_osds())
+                if not live:
+                    return
+                osd = live[0]
+            cluster.kill(osd)
+            self.killed.append(osd)
+            if self.kill_at is None:
+                self.kill_at = time.monotonic()
+        else:
+            osd = ev.osd
+            if osd is None:
+                if not self.killed:
+                    return
+                osd = self.killed[0]
+            cluster.revive(osd)
+            if osd in self.killed:
+                self.killed.remove(osd)
+            self.revive_at = time.monotonic()
+
+    def settle(self, cluster) -> None:
+        """Post-run: revive anything still dead, then wait for the
+        cluster to report recovered, stamping ``recovered_at``."""
+        for osd in list(self.killed):
+            cluster.revive(osd)
+            self.killed.remove(osd)
+            self.revive_at = time.monotonic()
+        if cluster.wait_recovered(self.recovery_timeout):
+            self.recovered_at = time.monotonic()
+
+    def metrics(self, recorder) -> dict:
+        """Degraded-window throughput + time-to-recovered rows."""
+        out: dict = {}
+        if self.kill_at is None:
+            return out
+        t_end = self.revive_at or time.monotonic()
+        out["degraded_gbps"] = round(
+            recorder.window_gbps(self.kill_at, t_end), 6
+        )
+        out["degraded_window_s"] = round(t_end - self.kill_at, 3)
+        if self.revive_at is not None and self.recovered_at is not None:
+            out["time_to_recovered_s"] = round(
+                self.recovered_at - self.revive_at, 3
+            )
+        return out

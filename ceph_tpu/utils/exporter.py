@@ -12,6 +12,7 @@ pipeline/daemon counter set registers there) the same way:
 - time accumulators -> ``counter`` (seconds, ``_seconds`` suffix)
 - averages          -> ``_sum`` + ``_count`` (an untyped summary)
 - histograms        -> ``_bucket{le=...}`` cumulative + ``_count``
+                       + ``_sum``
 
 Metric name = ``ceph_tpu_<key>``; the owning counter-set's name rides
 in a ``set`` label (the reference labels by daemon the same way, e.g.
@@ -89,6 +90,12 @@ def render_exposition(
                     f'{label},le="+Inf"', cum,
                 )
                 emit(f"{metric}_count", "untyped", label, cum)
+                # value total (rate(sum)/rate(count) = live mean);
+                # older dumps without it render count-only
+                if "sum" in v:
+                    emit(
+                        f"{metric}_sum", "untyped", label, v["sum"]
+                    )
     lines: list[str] = []
     for metric in sorted(metrics):
         typ, samples = metrics[metric]

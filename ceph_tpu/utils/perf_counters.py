@@ -35,11 +35,15 @@ class PerfCounters:
         self._schema = schema
         self._lock = threading.Lock()
         self._values: dict[str, object] = {}
+        #: histogram value totals (Prometheus histograms carry a
+        #: ``_sum`` so rate(sum)/rate(count) gives a live mean)
+        self._hist_sums: dict[str, float] = {}
         for key, spec in schema.items():
             if spec["type"] is CounterType.AVG:
                 self._values[key] = [0, 0.0]  # avgcount, sum
             elif spec["type"] is CounterType.HISTOGRAM:
                 self._values[key] = [0] * (len(spec["buckets"]) + 1)
+                self._hist_sums[key] = 0.0
             else:
                 self._values[key] = 0 if spec["type"] in (
                     CounterType.U64, CounterType.GAUGE
@@ -83,6 +87,7 @@ class PerfCounters:
         spec = self._check(key, CounterType.HISTOGRAM)
         with self._lock:
             self._values[key][bisect.bisect_right(spec["buckets"], value)] += 1
+            self._hist_sums[key] += value
 
     def get(self, key: str):
         with self._lock:
@@ -100,6 +105,7 @@ class PerfCounters:
                     out[key] = {
                         "buckets": list(spec["buckets"]),
                         "counts": list(v),
+                        "sum": self._hist_sums[key],
                     }
                 else:
                     out[key] = v

@@ -308,6 +308,9 @@ def _bare_daemon():
     d._req_windows = {}
     d._req_unverified = {}
     d._req_poll_at = {}
+    d._req_poll_results = {}
+    d._req_polls_inflight = set()
+    d._req_poll_lock = threading.Lock()
     d._req_flush = set()
     d._req_flush_lock = threading.Lock()
     return d

@@ -39,6 +39,22 @@ def payload(n, seed=0):
     ).tobytes()
 
 
+def execute_retry(d, make_op, tries=80, delay=0.05):
+    """Drive a daemon-direct op through the ASYNC durability fan-out
+    the way the objecter's backoff would: the first attempt spawns
+    the poll on its own thread and answers eagain; a later attempt
+    consumes the cached verdict. ``make_op`` must build a FRESH OSDOp
+    per attempt (the daemon rewrites msg.oid/msg.op in place)."""
+    import time as _time
+
+    for _ in range(tries):
+        r = d._execute_client_op(make_op())
+        if r.error != "eagain":
+            return r
+        _time.sleep(delay)
+    return r
+
+
 def test_write_read_roundtrip_over_wire(cluster):
     mon, daemons, client = cluster
     io = client.open_ioctx("ecpool")
@@ -792,10 +808,12 @@ def test_resent_append_survives_primary_failover(cluster):
     new_primary = mon.osdmap.primary("ecpool", "log")
     assert new_primary != primary
     d2 = next(dd for dd in daemons if dd.osd_id == new_primary)
-    # the client's resend of the SAME logical op
-    op2 = OSDOp(951, mon.osdmap.epoch, "ecpool", "log", "append",
-                data=rec, reqid="clientA.9")
-    r2 = d2._execute_client_op(op2)
+    # the client's resend of the SAME logical op (retrying through
+    # the async durability fan-out like the objecter's backoff)
+    r2 = execute_retry(d2, lambda: OSDOp(
+        951, mon.osdmap.epoch, "ecpool", "log", "append",
+        data=rec, reqid="clientA.9",
+    ))
     assert r2.error == "", r2.error
     assert r2.size == 2_300, "resent append re-applied after failover"
     assert io.stat("log") == 2_300
@@ -868,9 +886,10 @@ def test_nondurable_seeded_resend_reapplies(cluster):
 
     # the client's resend: without verification this replays size
     # 2300 while every other shard holds a 2000-byte object
-    op = OSDOp(960, mon.osdmap.epoch, "ecpool", "log", "append",
-               data=rec, reqid="clientA.9")
-    r = d2._execute_client_op(op)
+    r = execute_retry(d2, lambda: OSDOp(
+        960, mon.osdmap.epoch, "ecpool", "log", "append",
+        data=rec, reqid="clientA.9",
+    ))
     assert r.error == "", r.error
     assert r.size == 2_300
     # the re-apply healed the stripe everywhere: content is exact
@@ -915,9 +934,10 @@ def test_nondurable_resend_with_later_writes_fails(cluster):
         .setattr(key, OI_KEY, pack_oi(2_600, (ev[0], ev[1] + 9)))
     )
 
-    op = OSDOp(961, mon.osdmap.epoch, "ecpool", "log2", "append",
-               data=payload(300, seed=43), reqid="clientB.1")
-    r = d2._execute_client_op(op)
+    r = execute_retry(d2, lambda: OSDOp(
+        961, mon.osdmap.epoch, "ecpool", "log2", "append",
+        data=payload(300, seed=43), reqid="clientB.1",
+    ))
     assert r.error == "eio", (r.error, r.size)
 
 
@@ -964,9 +984,10 @@ def test_nondurable_entry_not_laundered_by_later_op(cluster):
     # ANOTHER client commits an append before the resend arrives —
     # its attr stamp must NOT carry the unverified clientC.1 entry
     mid = payload(100, seed=51)
-    opB = OSDOp(970, mon.osdmap.epoch, "ecpool", "log3", "append",
-                data=mid, reqid="clientD.1")
-    rB = d2._execute_client_op(opB)
+    rB = execute_retry(d2, lambda: OSDOp(
+        970, mon.osdmap.epoch, "ecpool", "log3", "append",
+        data=mid, reqid="clientD.1",
+    ))
     assert rB.error == "", rB.error
     # the torn 2300-size state was rolled back to the committed 2000
     # before B applied, so B landed at offset 2000
@@ -976,9 +997,10 @@ def test_nondurable_entry_not_laundered_by_later_op(cluster):
     # the suspect resend now finds no window entry (erased as
     # non-durable) and executes as a FRESH append — never a replay
     rec = payload(300, seed=52)
-    opA = OSDOp(971, mon.osdmap.epoch, "ecpool", "log3", "append",
-                data=rec, reqid="clientC.1")
-    rA = d2._execute_client_op(opA)
+    rA = execute_retry(d2, lambda: OSDOp(
+        971, mon.osdmap.epoch, "ecpool", "log3", "append",
+        data=rec, reqid="clientC.1",
+    ))
     assert rA.error == "", rA.error
     assert rA.size == 2_400, (
         "resend must re-execute after its entry was erased, "

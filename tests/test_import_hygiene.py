@@ -13,6 +13,7 @@ import sys
 _CHECK = """
 import ceph_tpu
 import ceph_tpu.checksum, ceph_tpu.codecs, ceph_tpu.cluster, ceph_tpu.msg
+import ceph_tpu.loadgen
 import ceph_tpu.parallel, ceph_tpu.pipeline, ceph_tpu.store, ceph_tpu.utils
 import jax._src.xla_bridge as xb
 assert not xb._backends, f"backend initialized at import: {list(xb._backends)}"
