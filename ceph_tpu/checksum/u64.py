@@ -64,6 +64,30 @@ def mul(a: U64, b: U64) -> U64:
     return (hi, lo)
 
 
+def mul_const(a: U64, c: int) -> U64:
+    """Low 64 bits of a * constant.  The constant's 16-bit limbs stay
+    Python ints (weak-typed scalars), so the per-call limb splits of
+    the generic ``mul`` — two mask/shift round-trips per operand —
+    drop out; xxh64's per-stripe rounds are all constant multiplies."""
+    cl, ch = c & 0xFFFFFFFF, (c >> 32) & 0xFFFFFFFF
+    al, ah = a[1] & _MASK16, a[1] >> 16
+    # 16-bit limbs stay weak-typed python ints; the full 32-bit words
+    # must wrap in uint32 explicitly (>= 2^31 overflows weak int32)
+    bl, bh = cl & _MASK16, cl >> 16
+    cl, ch = jnp.uint32(cl), jnp.uint32(ch)
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    mid = lh + (hl & _MASK16)
+    mid_carry = (mid < lh).astype(jnp.uint32)
+    lo = ll + (mid << 16)
+    lo_carry = (lo < ll).astype(jnp.uint32)
+    hi = hh + (hl >> 16) + (mid >> 16) + (mid_carry << 16) + lo_carry
+    hi = hi + a[1] * ch + a[0] * cl
+    return (hi, lo)
+
+
 def rotl(a: U64, r: int) -> U64:
     r &= 63
     if r == 0:

@@ -3,9 +3,21 @@
 Unlike CRC, xxhash is non-linear (multiplicative avalanche), so each
 block is a true sequential chain — the TPU win is batch parallelism:
 deep scrub checksums thousands of blocks at once, so the kernel scans
-stripes with a [B, 4]-lane accumulator on the VPU while blocks fill
-the vector lanes. Mirrors the exact algorithm Checksummer wraps
-(src/common/Checksummer.h:137-193, vendored src/xxHash).
+stripes while blocks fill the vector lanes. Mirrors the exact
+algorithm Checksummer wraps (src/common/Checksummer.h:137-193,
+vendored src/xxHash).
+
+v2 layout (round 9): ACCUMULATORS ARE FULL-LANE VECTORS.  The round-3
+kernel carried a ``[B, 4]`` accumulator — 4 active lanes of a 128-lane
+VPU row, 3% utilization on every rotate/multiply, which is why the
+honest r5 numbers sat at ~62 GB/s while crc32c's fold ran ~178.  Now
+the block words are bitcast to uint32 and transposed ONCE to word-
+major ``[W, B]`` (a single relayout pass over the input), so each of
+the four xxhash accumulator lanes is its own ``[B]`` vector with
+blocks on the VPU lanes: every round op runs at full width, and the
+uint32-pair u64 emulation (``u64.py``) does too.  Constant multiplies
+ride ``u64.mul_const`` — the constant's 16-bit limbs are Python ints,
+so each round saves the limb-split round-trips of a generic mul.
 
 Block sizes are static (csum_block_size), so tail handling is resolved
 at trace time; csum blocks are whole stripes in practice (4K+), but
@@ -37,18 +49,23 @@ def _rotl32(x: jax.Array, r: int) -> jax.Array:
 
 def _unroll_split(nsteps: int, cap: int = 16) -> tuple[int, int]:
     """(f, main): the scan runs ``main // f`` steps with ``f`` rounds
-    unrolled per step (per-step scan overhead on tiny [B, 4] bodies
-    dominated the whole kernel); the ``nsteps - main`` remainder
-    stripes run eagerly after the scan. No divisibility requirement —
-    a prime stripe count must not fall back to the 1-per-step cliff."""
+    unrolled per step (per-step scan overhead dominated unfused
+    bodies); the ``nsteps - main`` remainder stripes run eagerly after
+    the scan. No divisibility requirement — a prime stripe count must
+    not fall back to the 1-per-step cliff."""
     f = min(cap, nsteps)
     return f, (nsteps // f) * f
 
 
-def _le32(b: jax.Array) -> jax.Array:
-    """[..., 4] uint8 -> [...] uint32 little-endian — a free bitcast
-    (TPU and the CPU CI backend are both little-endian)."""
-    return jax.lax.bitcast_convert_type(b, jnp.uint32)
+def _words_t(data: jax.Array, nwords: int) -> jax.Array:
+    """[B, L] uint8 -> word-major [nwords, B] uint32 (little-endian —
+    a free bitcast; TPU and the CPU CI backend agree).  The ONE
+    transpose that puts blocks on the VPU lanes for the whole chain."""
+    bsz = data.shape[0]
+    w = jax.lax.bitcast_convert_type(
+        data[:, : nwords * 4].reshape(bsz, nwords, 4), jnp.uint32
+    )  # [B, W]
+    return w.T  # [W, B]
 
 
 @functools.partial(jax.jit, static_argnames=("block_bytes",))
@@ -60,52 +77,43 @@ def xxh32_kernel(
     n = block_bytes
     bsz = data.shape[0]
     seed = seed.astype(jnp.uint32)
+    wt = _words_t(data, n // 4) if n >= 4 else None
     i = 0
     if n >= 16:
         nstripes = n // 16
-        init = jnp.broadcast_to(
-            jnp.stack([seed + p1 + p2, seed + p2, seed, seed - p1]),
-            (bsz, 4),
+        init = tuple(
+            jnp.broadcast_to(s, (bsz,))
+            for s in (seed + p1 + p2, seed + p2, seed, seed - p1)
         )
         f, main = _unroll_split(nstripes)
-        # Keep the scanned operand in BYTES ([G, B, f*16] uint8) and
-        # build the uint32 lanes inside the body: pre-materializing
-        # _le32 over the whole input wrote a 4x-expanded uint32
-        # tensor (plus its transpose) through HBM — 5x the kernel's
-        # true traffic and the actual bottleneck.
-        grouped = (
-            data[:, : main * 16]
-            .reshape(bsz, main // f, f * 16)
-            .swapaxes(0, 1)
-        )
+        grouped = wt[: main * 4].reshape(main // f, f * 4, bsz)
 
-        def body(acc, group):  # group [B, f*16] uint8
-            lanes = _le32(group.reshape(bsz, f, 4, 4))  # [B, f, 4]
+        def round_(acc, lanes):  # acc 4x[B], lanes 4x[B]
+            return tuple(
+                _rotl32(acc[l] + lanes[l] * p2, 13) * p1
+                for l in range(4)
+            )
+
+        def body(acc, group):  # group [f*4, B]
             for j in range(f):
-                acc = acc + lanes[:, j] * p2
-                acc = _rotl32(acc, 13) * p1
+                acc = round_(acc, [group[j * 4 + l] for l in range(4)])
             return acc, None
 
         acc, _ = jax.lax.scan(body, init, grouped)
         for s in range(main, nstripes):  # remainder stripes, eager
-            lanes = _le32(
-                data[:, s * 16 : (s + 1) * 16].reshape(bsz, 4, 4)
-            )
-            acc = acc + lanes * p2
-            acc = _rotl32(acc, 13) * p1
+            acc = round_(acc, [wt[s * 4 + l] for l in range(4)])
         h = (
-            _rotl32(acc[:, 0], 1)
-            + _rotl32(acc[:, 1], 7)
-            + _rotl32(acc[:, 2], 12)
-            + _rotl32(acc[:, 3], 18)
+            _rotl32(acc[0], 1)
+            + _rotl32(acc[1], 7)
+            + _rotl32(acc[2], 12)
+            + _rotl32(acc[3], 18)
         )
         i = nstripes * 16
     else:
         h = jnp.broadcast_to(seed + p5, (bsz,))
     h = h + jnp.uint32(n)
     while i + 4 <= n:
-        lane = _le32(data[:, i : i + 4])
-        h = _rotl32(h + lane * p3, 17) * p4
+        h = _rotl32(h + wt[i // 4] * p3, 17) * p4
         i += 4
     while i < n:
         h = _rotl32(h + data[:, i].astype(jnp.uint32) * p5, 11) * p1
@@ -117,23 +125,11 @@ def xxh32_kernel(
     return h ^ (h >> 16)
 
 
-def _le64_pair(b: jax.Array):
-    """[..., 8] uint8 -> (hi, lo) uint32 little-endian.
-
-    A BITCAST, not byte shifts: the lanes are already little-endian
-    contiguous bytes, so reinterpreting [..., 2, 4] uint8 as uint32
-    is free — the shift-assembly this replaces cost ~10 VPU ops per
-    lane and measured up to 38% of the whole xxh64 kernel (round 4)."""
-    w = jax.lax.bitcast_convert_type(
-        b.reshape(b.shape[:-1] + (2, 4)), jnp.uint32
-    )
-    return (w[..., 1], w[..., 0])
-
-
 def _xxh64_round(acc, lane):
-    p1 = u64.from_const(_P64[0])
-    p2 = u64.from_const(_P64[1])
-    return u64.mul(u64.rotl(u64.add(acc, u64.mul(lane, p2)), 31), p1)
+    return u64.mul_const(
+        u64.rotl(u64.add(acc, u64.mul_const(lane, _P64[1])), 31),
+        _P64[0],
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("block_bytes",))
@@ -149,77 +145,74 @@ def xxh64_kernel(
         jnp.broadcast_to(seed_lo.astype(jnp.uint32), (bsz,)),
     )
     zero = (jnp.zeros((bsz,), jnp.uint32), jnp.zeros((bsz,), jnp.uint32))
+    wt = _words_t(data, n // 4) if n >= 4 else None
+
+    def lane64(widx: int):  # (hi, lo) [B] pair at word index
+        return (wt[widx + 1], wt[widx])
+
     i = 0
     if n >= 32:
         nstripes = n // 32
-        init4 = [
-            u64.add(seed, u64.add(p1, p2)),
-            u64.add(seed, p2),
-            seed,
-            # seed - P1 == seed + (~P1 + 1) — two's complement negation.
-            u64.add(seed, u64.from_const((-_P64[0]) & ((1 << 64) - 1))),
-        ]
-        init = (
-            jnp.stack([a[0] for a in init4], axis=-1),  # hi [B, 4]
-            jnp.stack([a[1] for a in init4], axis=-1),  # lo [B, 4]
+        init = tuple(
+            u64.add(seed, c)
+            for c in (
+                u64.add(p1, p2), p2, u64.from_const(0),
+                # seed - P1 == seed + (~P1 + 1), two's complement.
+                u64.from_const((-_P64[0]) & ((1 << 64) - 1)),
+            )
         )
-
         f, main = _unroll_split(nstripes)
-        # bytes stay bytes until inside the body (see xxh32_kernel)
-        grouped = (
-            data[:, : main * 32]
-            .reshape(bsz, main // f, f * 32)
-            .swapaxes(0, 1)
-        )
+        grouped = wt[: main * 8].reshape(main // f, f * 8, bsz)
 
-        def body(acc, group):  # group [B, f*32] uint8
-            hi, lo = _le64_pair(
-                group.reshape(bsz, f, 4, 8)
-            )  # each [B, f, 4]
+        def body(acc, group):  # group [f*8, B]
             for j in range(f):
-                acc = _xxh64_round(acc, (hi[:, j], lo[:, j]))
+                acc = tuple(
+                    _xxh64_round(
+                        acc[l],
+                        (group[j * 8 + 2 * l + 1], group[j * 8 + 2 * l]),
+                    )
+                    for l in range(4)
+                )
             return acc, None
 
         acc, _ = jax.lax.scan(body, init, grouped)
         for s in range(main, nstripes):  # remainder stripes, eager
-            hi, lo = _le64_pair(
-                data[:, s * 32 : (s + 1) * 32].reshape(bsz, 4, 8)
+            acc = tuple(
+                _xxh64_round(acc[l], lane64(s * 8 + 2 * l))
+                for l in range(4)
             )
-            acc = _xxh64_round(acc, (hi, lo))
-        accs = [(acc[0][:, j], acc[1][:, j]) for j in range(4)]
         h = u64.add(
-            u64.add(u64.rotl(accs[0], 1), u64.rotl(accs[1], 7)),
-            u64.add(u64.rotl(accs[2], 12), u64.rotl(accs[3], 18)),
+            u64.add(u64.rotl(acc[0], 1), u64.rotl(acc[1], 7)),
+            u64.add(u64.rotl(acc[2], 12), u64.rotl(acc[3], 18)),
         )
-        for j in range(4):
-            h = u64.xor(h, _xxh64_round(zero, accs[j]))
-            h = u64.add(u64.mul(h, p1), p4)
+        for l in range(4):
+            h = u64.xor(h, _xxh64_round(zero, acc[l]))
+            h = u64.add(u64.mul_const(h, _P64[0]), p4)
         i = nstripes * 32
     else:
         h = u64.add(seed, p5)
     h = u64.add(h, u64.from_const(n))
     while i + 8 <= n:
-        lane = _le64_pair(data[:, i : i + 8])
-        h = u64.xor(h, _xxh64_round(zero, lane))
-        h = u64.add(u64.mul(u64.rotl(h, 27), p1), p4)
+        h = u64.xor(h, _xxh64_round(zero, lane64(i // 4)))
+        h = u64.add(u64.mul_const(u64.rotl(h, 27), _P64[0]), p4)
         i += 8
     if i + 4 <= n:
-        lane = (jnp.zeros((bsz,), jnp.uint32), _le32(data[:, i : i + 4]))
-        h = u64.xor(h, u64.mul(lane, p1))
-        h = u64.add(u64.mul(u64.rotl(h, 23), p2), p3)
+        lane = (jnp.zeros((bsz,), jnp.uint32), wt[i // 4])
+        h = u64.xor(h, u64.mul_const(lane, _P64[0]))
+        h = u64.add(u64.mul_const(u64.rotl(h, 23), _P64[1]), p3)
         i += 4
     while i < n:
         byte = (
             jnp.zeros((bsz,), jnp.uint32),
             data[:, i].astype(jnp.uint32),
         )
-        h = u64.xor(h, u64.mul(byte, p5))
-        h = u64.mul(u64.rotl(h, 11), p1)
+        h = u64.xor(h, u64.mul_const(byte, _P64[4]))
+        h = u64.mul_const(u64.rotl(h, 11), _P64[0])
         i += 1
     h = u64.xor(h, u64.shr(h, 33))
-    h = u64.mul(h, p2)
+    h = u64.mul_const(h, _P64[1])
     h = u64.xor(h, u64.shr(h, 29))
-    h = u64.mul(h, p3)
+    h = u64.mul_const(h, _P64[2])
     h = u64.xor(h, u64.shr(h, 32))
     return h
 

@@ -3239,7 +3239,10 @@ class OSDDaemon:
         size_hint: int = -1,
     ) -> None:
         """Push one object's shards to the CRUSH target layout."""
-        from ceph_tpu.pipeline.read import get_min_avail_to_read_shards
+        from ceph_tpu.pipeline.read import (
+            get_min_avail_to_read_shards,
+            reconstruct_shards,
+        )
         from ceph_tpu.pipeline.shard_map import ShardExtentMap
 
         target = self.osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
@@ -3277,7 +3280,13 @@ class OSDDaemon:
             ).items():
                 smap.insert(sr.shard, start, buf)
         if need_decode:
-            smap.decode(pg.codec, {i for i in moves if i not in avail}, size)
+            # reconstruct_shards, not a bare smap.decode: when the
+            # plan carried CLAY sub-chunk selectors the survivors hold
+            # only repair planes, which fractional repair consumes and
+            # a windowed decode would mis-read as missing data
+            reconstruct_shards(
+                pg.sinfo, pg.codec, smap, want, reads, size
+            )
         hinfo = pg.rmw.hinfo(oid)
         my_key = self._my_key(pg, oid)
         try:

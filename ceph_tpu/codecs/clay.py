@@ -208,6 +208,12 @@ class ClayCodec(ErasureCodeBase):
         # U_hi, U_lo); any 2 of the 4 determine the rest (RS(2,2) MDS).
         self._g4 = vandermonde_rs_matrix(2, 2)  # [4, 2]
         self._pair_cache: dict[tuple, tuple[int, int]] = {}
+        #: static kernel-repair plans keyed by (lost_node, aloof set):
+        #: digit strides, member kinds, pair coefficients, score
+        #: groups and B2 patch items — all host-side planning shared
+        #: by every traced repair of the same erasure pattern (the
+        #: device decode matrices ride mds._tables / dev_bmat).
+        self._kernel_plans: dict[tuple, dict] = {}
 
     # -- geometry ------------------------------------------------------
     def get_sub_chunk_count(self) -> int:
@@ -696,12 +702,25 @@ class ClayCodec(ErasureCodeBase):
         for i in range(self.k, self.k + self.nu):
             helper[i] = zeros(lead + (r, sc), np.uint8)
 
+        if traced:
+            # Plane-blocked Pallas kernels: general d (aloof nodes
+            # enter the per-group uncoupled solves as decoded known
+            # rows) at any sub_chunk_no — HBM sees each helper byte
+            # once in, each recovered byte once out.
+            kout = self._repair_kernels(
+                lost_node, helper, aloof, sc
+            )
+            if kout is not None:
+                out = kout.reshape(lead + (self.sub_chunk_no * sc,))
+                return {lost: out}
         if traced and not aloof:
             # d = k+m-1 (no aloof nodes): every repair plane has
             # intersection score 1 and the whole repair collapses to
-            # three whole-tensor stages — the fast path (the itemized
-            # stacked path below gathers hundreds of per-plane slices
-            # and measured 20 GB/s against this path's device rate).
+            # three whole-tensor stages — the XLA fast path when the
+            # kernels are gated off or the geometry does not fit (the
+            # itemized stacked path below gathers hundreds of
+            # per-plane slices and measured 20 GB/s against this
+            # path's device rate).
             recovered = self._repair_fast(
                 lost_node, helper, repair_planes, plane_ind
             )
@@ -809,12 +828,6 @@ class ClayCodec(ErasureCodeBase):
         P = len(repair_planes)
         pvecs = [self._plane_vector(z) for z in repair_planes]
         sc = helper[next(iter(helper))].shape[-1]
-
-        kernel_out = self._repair_fast_kernels(
-            lost_node, helper, repair_planes, plane_ind, pvecs, sc
-        )
-        if kernel_out is not None:
-            return kernel_out
 
         # -- a: uncoupled values of every non-lost row ---------------
         U: dict[int, jax.Array] = {}
@@ -934,103 +947,222 @@ class ClayCodec(ErasureCodeBase):
                 inv[z_dst] = x * P + p
         return jnp.take(flat, jnp.asarray(inv), axis=-2)
 
-    def _canonical_pair_algebra(self) -> bool:
-        """True when the coupling coefficients reduce to the
-        U = C ^ 2*(C_hi^C_lo) / C = C_x ^ inv2*(C_x^U_x) one-step
-        forms the Pallas repair kernels hard-code."""
-        try:
-            return (
-                self._pair_coeffs((0, 1), 2) == (3, 2)
-                and self._pair_coeffs((0, 1), 3) == (2, 3)
-                and self._pair_coeffs((0, 2), 1) == (143, 142)
-                and self._pair_coeffs((1, 3), 0) == (143, 142)
-            )
-        except Exception:
-            return False
+    # -- Pallas kernel repair (general d, plane-blocked) ---------------
+    def _kernel_plan(self, lost_node: int, aloof: frozenset) -> dict:
+        """Static planning for the kernel repair path, cached per
+        (lost node, aloof set) — digit strides, member kinds, pair
+        coefficients, intersection-score groups and the B2 patch
+        items.  Pure host arithmetic: one dict serves every traced
+        repair of the same erasure pattern."""
+        key = (lost_node, aloof)
+        plan = self._kernel_plans.get(key)
+        if plan is None:
+            plan = self._build_kernel_plan(lost_node, aloof)
+            self._kernel_plans[key] = plan
+        return plan
 
-    def _repair_fast_kernels(
-        self, lost_node, helper, repair_planes, plane_ind, pvecs, sc
-    ):
-        """All three repair stages as two Pallas kernels + one stacked
-        MXU decode (ops/clay_kernels.py): HBM sees each helper byte
-        once in, each recovered byte once out — the XLA formulation's
-        stack/gather/permute intermediates cost ~10x the payload in
-        HBM traffic. Returns None when the geometry or the coupling
-        algebra doesn't fit (the XLA fast path takes over)."""
+    def _build_kernel_plan(self, lost_node: int, aloof: frozenset) -> dict:
+        q, t = self.q, self.t
+        y_l, x_l = lost_node // q, lost_node % q
+        r = self.sub_chunk_no // q
+        rows = [y for y in range(t) if y != y_l]
+
+        def stride(y: int) -> int:
+            # repair-index stride of digit y: q per free digit minor
+            # to it (free = every row but y_l; y=0 most significant)
+            return _pow_int(q, sum(1 for y2 in rows if y2 > y))
+
+        def kind(node: int) -> str:
+            if node in aloof:
+                return "a"
+            if self.k <= node < self.k + self.nu:
+                return "v"
+            return "r"
+
+        strides = tuple(stride(y) for y in rows)
+        kinds = tuple(
+            tuple(kind(y * q + x) for x in range(q)) for y in rows
+        )
+        lost_kinds = tuple(kind(y_l * q + x) for x in range(q))
+        # (self, partner) coefficients: forward transform U_self from
+        # (C_self, C_partner), hi/lo member; inverse C_lost from
+        # (C_helper, U_helper) of a lost-row member.
+        pair_fwd = (
+            self._pair_coeffs((0, 1), 2),
+            self._pair_coeffs((1, 0), 3),
+        )
+        pair_inv = (
+            self._pair_coeffs((0, 2), 1),
+            self._pair_coeffs((1, 3), 0),
+        )
+        present = [
+            y * q + x
+            for y in rows
+            for x in range(q)
+            if (y * q + x) not in aloof
+        ]
+        want = sorted({y_l * q + x for x in range(q)} | aloof)
+
+        def digit(p: int, y: int) -> int:
+            return (p // stride(y)) % q
+
+        score = [
+            1 + sum(
+                1 for nd in aloof if digit(p, nd // q) == nd % q
+            )
+            for p in range(r)
+        ]
+        groups: dict[int, np.ndarray] = {}
+        for s in sorted(set(score)):
+            groups[s] = np.array(
+                [p for p in range(r) if score[p] == s], np.int64
+            )
+        # B2 patch items: helpers sharing a row with an aloof node, at
+        # the planes where that aloof node is a dot.  Their uncoupled
+        # value needs the aloof node's U from the companion plane (one
+        # score lower) — patched between group decodes.
+        patches: dict[int, list] = {}
+        for nd_a in sorted(aloof):
+            x_a, y_a = nd_a % q, nd_a // q
+            s_a = stride(y_a)
+            dots = [p for p in range(r) if digit(p, y_a) == x_a]
+            for x in range(q):
+                nd = y_a * q + x
+                if x == x_a or nd in aloof:
+                    continue
+                node_c, node_u = self._pair_idx(x, x_a)
+                _sw_c, sw_u = self._pair_idx(x_a, x)
+                c0, c1 = self._pair_coeffs((node_c, sw_u), node_u)
+                by_score: dict[int, list[int]] = {}
+                for p in dots:
+                    by_score.setdefault(score[p], []).append(p)
+                for s, ps in by_score.items():
+                    psw = [p + (x - x_a) * s_a for p in ps]
+                    patches.setdefault(s, []).append((
+                        nd, nd_a,
+                        np.array(ps, np.int64),
+                        np.array(psw, np.int64),
+                        c0, c1,
+                    ))
+        return {
+            "rows": rows,
+            "strides": strides,
+            "kinds": kinds,
+            "lost_kinds": lost_kinds,
+            "pair_fwd": pair_fwd,
+            "pair_inv": pair_inv,
+            "present": present,
+            "want": want,
+            "groups": groups,
+            "patches": patches,
+            "seq": _pow_int(q, sum(1 for y2 in rows if y2 > y_l)),
+        }
+
+    def _repair_kernels(self, lost_node, helper, aloof, sc):
+        """All repair stages on the plane-blocked Pallas kernels
+        (ops/clay_kernels.py) + per-score-group MXU decodes: HBM sees
+        each helper byte once in, each recovered byte once out — the
+        XLA formulation's stack/gather/permute intermediates cost
+        ~10x the payload in HBM traffic.  General d: aloof nodes are
+        decoded alongside the lost row and their U feeds the next
+        score group's B2 patches (repair_one_lost_chunk's helper
+        split, ErasureCodeClay.cc:454-699).  Returns None when the
+        kernels are gated off or the geometry does not fit (the XLA
+        paths take over)."""
         import numpy as _np
 
         from ceph_tpu.ops import clay_kernels
         from ceph_tpu.ops.pallas_encode import on_tpu as _on_tpu
+        from ceph_tpu.utils import config
 
-        q, t, n = self.q, self.t, self.q * self.t
-        y_l, x_l = lost_node // q, lost_node % q
-        P = len(repair_planes)
+        q, t = self.q, self.t
+        r = self.sub_chunk_no // q
         sample = helper[next(iter(helper))]
         lead = sample.shape[:-2]
         b = int(_np.prod(lead, initial=1))
         if (
-            self.scalar_mds not in ("jerasure", "isa")
-            or not clay_kernels.supported(b, sc, self.sub_chunk_no)
-            or not self._canonical_pair_algebra()
+            not config.get("ec_clay_kernels")
+            or self.scalar_mds not in ("jerasure", "isa")
+            or not clay_kernels.supported(b, sc, q, t)
         ):
             return None
+        import jax.numpy as jnp
+
         from .matrix_codec import dev_bmat
 
-        rows = [y for y in range(t) if y != y_l]
-        pvec_y = [[pvecs[p][y] for p in range(P)] for y in rows]
-        swap_p = [
-            [
-                [
-                    plane_ind[
-                        repair_planes[p]
-                        + (x - pvecs[p][y]) * _pow_int(q, t - 1 - y)
-                    ]
-                    if pvecs[p][y] != x
-                    else p
-                    for p in range(P)
-                ]
-                for x in range(q)
-            ]
-            for y in rows
-        ]
+        plan = self._kernel_plan(lost_node, frozenset(aloof))
         interp = not _on_tpu()
-        flat = [
-            helper[y * q + x].reshape((b, P * sc))
-            for y in rows
+        flat = {
+            node: helper[node].reshape((b, r * sc)) for node in helper
+        }
+        real_in = [
+            flat[y * q + x]
+            for ri, y in enumerate(plan["rows"])
             for x in range(q)
+            if plan["kinds"][ri][x] == "r"
         ]
-        ks = clay_kernels.uncoupled_rows(
-            rows, q, pvec_y, swap_p, flat, sc, interp
-        )  # [b, (t-1)q, P*sc]
-
-        erased_row = {y_l * q + x for x in range(q)}
-        present = [nd for nd in range(n) if nd not in erased_row]
-        want = sorted(erased_row)
+        # stage a: every B1 pair transform in one plane-blocked pass
+        U = dict(zip(plan["present"], clay_kernels.uncoupled_rows(
+            q, plan["strides"], plan["kinds"], plan["pair_fwd"],
+            real_in, r, sc, interp,
+        )))
+        # stage b: inner-MDS decode of lost row + aloof, one dispatch
+        # per intersection-score group (aloof-free: exactly one).
+        present, want = plan["present"], plan["want"]
         key = (tuple(present), tuple(want))
         bmat_np = self.mds._tables.get(
             key, lambda: self.mds._build_decode_bmat(present, want)
         )
-        dec = self.mds._dispatch_bitmatrix(
-            bmat_np,
-            dev_bmat(self.mds._tables, key, bmat_np, True),
-            ks, "decode",
-        )  # [b, q, P*sc]
-
-        dst_p = [
-            [
-                repair_planes[p] + (x - x_l) * _pow_int(q, t - 1 - y_l)
-                for p in range(P)
-            ]
+        bdev = dev_bmat(self.mds._tables, key, bmat_np, True)
+        groups = plan["groups"]
+        if len(groups) == 1:
+            dec = self.mds._dispatch_bitmatrix_shards(
+                bmat_np, bdev, [U[nd] for nd in present], "decode"
+            )
+            Uw = dict(zip(want, dec))
+        else:
+            Uv = {nd: U[nd].reshape(b, r, sc) for nd in present}
+            Uwb = {
+                nd: jnp.zeros((b, r, sc), _np.uint8) for nd in want
+            }
+            for s in sorted(groups):
+                for (nd, nd_a, ps, psw, c0, c1) in plan[
+                    "patches"
+                ].get(s, ()):
+                    cx = jnp.take(
+                        flat[nd].reshape(b, r, sc),
+                        jnp.asarray(ps), axis=1,
+                    )
+                    ua = jnp.take(Uwb[nd_a], jnp.asarray(psw), axis=1)
+                    val = (
+                        _gf_mul_traced(c0, cx)
+                        ^ _gf_mul_traced(c1, ua)
+                    )
+                    Uv[nd] = Uv[nd].at[:, ps, :].set(val)
+                zsel = jnp.asarray(groups[s])
+                known = [
+                    jnp.take(Uv[nd], zsel, axis=1).reshape(b, -1)
+                    for nd in present
+                ]
+                dec = self.mds._dispatch_bitmatrix_shards(
+                    bmat_np, bdev, known, "decode"
+                )
+                for i, nd in enumerate(want):
+                    Uwb[nd] = Uwb[nd].at[:, groups[s], :].set(
+                        dec[i].reshape(b, len(groups[s]), sc)
+                    )
+            Uw = {nd: v.reshape(b, r * sc) for nd, v in Uwb.items()}
+        # stage c: couple + blocked scatter of the lost chunk
+        y_l, x_l = lost_node // q, lost_node % q
+        udec = [Uw[y_l * q + x] for x in range(q)]
+        lost_help = [
+            flat[y_l * q + x]
             for x in range(q)
-        ]
-        lost_helpers = [
-            helper[y_l * q + x].reshape((b, P * sc))
-            for x in range(q)
-            if x != x_l
+            if x != x_l and plan["lost_kinds"][x] == "r"
         ]
         rec = clay_kernels.couple_scatter(
-            q, x_l, dst_p, dec, lost_helpers, sc,
-            self.sub_chunk_no, interp,
+            q, x_l, plan["lost_kinds"], plan["pair_inv"],
+            udec, lost_help, plan["seq"], r, sc, interp,
         )
         return rec.reshape(lead + (self.sub_chunk_no, sc))
 

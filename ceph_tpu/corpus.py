@@ -84,9 +84,22 @@ V1_SUITE: list[tuple[str, dict[str, str]]] = [
 # ((4,3,d=6): q=3 does not divide k+m=7, so nu=2 virtual zero chunks
 # pad the inner code — the ErasureCodeClay.cc:330 shortening path the
 # v0 (4,2,d=5) entry never exercises).
-V2_SUITE: list[tuple[str, dict[str, str]]] = [
+#
+# Round 9 adds the general-d kernel-path profiles: (6,3,d=7) is
+# ALOOF + SHORTENED at once (one aloof node, nu=1 virtual chunk —
+# the B1/B2 split with virtual members in the aloof row), and the
+# (4,2,d=5) @ 516 KiB entry pins a chunk whose
+# ``SB * sub_chunk_no * sc`` (2 Mi lanes at sc=16512) overflowed the
+# retired round-7 whole-chunk scatter budget — the plane-blocked
+# kernels must keep re-encoding/repairing it bit-identically
+# (tests/test_clay_general_d.py runs repair-vs-archive through the
+# kernels in interpret mode).  An optional third tuple element is the
+# payload size (default PAYLOAD_SIZE).
+V2_SUITE: list[tuple] = [
     ("clay", {"k": "8", "m": "4", "d": "10"}),
     ("clay", {"k": "4", "m": "3", "d": "6"}),
+    ("clay", {"k": "6", "m": "3", "d": "7"}),
+    ("clay", {"k": "4", "m": "2", "d": "5"}, 4 * 132096),
 ]
 
 SUITES = {"v0": DEFAULT_SUITE, "v1": V1_SUITE, "v2": V2_SUITE}
@@ -214,8 +227,10 @@ def main(argv: list[str] | None = None) -> int:
                 f"--base must end in a known corpus version "
                 f"({sorted(SUITES)}), got {version!r}"
             )
-        for plugin, profile in suite:
-            path = run_create(args.base, plugin, profile, args.size)
+        for entry in suite:
+            plugin, profile = entry[0], entry[1]
+            size = entry[2] if len(entry) > 2 else args.size
+            path = run_create(args.base, plugin, profile, size)
             print(f"created {path}")
         return 0
 

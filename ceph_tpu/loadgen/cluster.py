@@ -29,6 +29,7 @@ class LoadCluster:
         pool: str = "loadpool",
         plugin: str = "jerasure",
         technique: str = "reed_sol_van",
+        d: int | None = None,
         store_factory=None,
         tick_period: float = 0.2,
         client_backoff: float = 0.02,
@@ -37,6 +38,7 @@ class LoadCluster:
     ) -> None:
         if n_osds < k + m:
             raise ValueError(f"need >= k+m={k + m} OSDs, got {n_osds}")
+        clay_d = d  # the daemon boot loop below reuses the name ``d``
         self.pool = pool
         self.k, self.m = k, m
         self.chunk_size = chunk_size
@@ -60,6 +62,20 @@ class LoadCluster:
         }
         if plugin == "jerasure":
             profile["technique"] = technique
+        if plugin == "clay":
+            # CLAY pools at the cluster tier: d steers the MSR repair
+            # bandwidth (default k+m-1); chunks must split into q^t
+            # lane-aligned sub-chunks for the fractional sub-reads
+            if clay_d is not None:
+                profile["d"] = str(clay_d)
+            from ceph_tpu.codecs import registry as _reg
+
+            sub = _reg.factory("clay", dict(profile)).get_sub_chunk_count()
+            if chunk_size % sub:
+                raise ValueError(
+                    f"chunk_size {chunk_size} must divide into the "
+                    f"pool's {sub} CLAY sub-chunks"
+                )
         self.mon.osd_erasure_code_profile_set("loadprof", profile)
         self.mon.osd_pool_create(pool, pg_num, "loadprof")
         # short op timeout: a kill can eat an in-flight op's reply

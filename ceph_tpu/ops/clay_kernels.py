@@ -1,24 +1,52 @@
-"""Pallas kernels for the CLAY aloof-free fast repair path.
+"""Pallas kernels for CLAY fractional repair — general d, any chunk.
 
 The XLA formulation of the repair stages (stack rows -> plane-permute
 gather -> fused pair transform; pair-combine -> stack -> inverse
 permute) pays every intermediate against HBM: ~500 MB of traffic to
-repair 45 MB of helper bytes. These kernels express the SAME algebra
-as in-VMEM lane-slice networks — each plane is a contiguous ``sc``-lane
-block of a shard row, so the pair transform and the final plane
-scatter are static slice arithmetic inside one grid step, and HBM sees
-each byte once in and once out.
+repair 45 MB of helper bytes.  These kernels express the SAME algebra
+as lane-sliced networks: every (node, plane-digit) class of a helper
+row is one 2D ``(sb, lb)`` lane block whose companion block is another
+ref of the same pallas_call, so each pair transform is a handful of
+packed-int32 VPU ops and HBM sees each helper byte once in, each
+recovered byte once out.
 
-Pair algebra (fixed by the construction's RS(2,2) coupling matrix,
-codecs/clay.py): U = C ^ 2*(C_hi ^ C_lo) both ways, and its inverse
-C_lost = C ^ inv2*(C ^ U). GF mul/div-by-2 run on int32 lanes holding
-4 packed bytes (Mosaic cannot shift i8 vectors): shift, then mask the
-cross-byte leak, then fold the reduction polynomial per byte. The
-caller verifies the codec's coefficients match before routing here
-(falls back to the XLA path otherwise).
+v2 design (round 9), replacing the aloof-free whole-chunk kernels:
 
-Matches repair_one_lost_chunk (ErasureCodeClay.cc:454-699) restricted
-to aloof == {}.
+- **General d.**  Repair with ``k <= d < k+m-1`` leaves ``k+m-1-d``
+  helper nodes "aloof".  Aloof nodes contribute no helper bytes; their
+  uncoupled values come out of the per-score-group inner-MDS decodes
+  and re-enter the NEXT group's solve as known rows — the B1/B2
+  helper split of ``repair_one_lost_chunk`` (ErasureCodeClay.cc:
+  454-699).  The kernel computes every pair transform that does not
+  depend on an aloof U (B1) and emits the helper's own coupled value
+  as a placeholder for the few that do (B2); the codec patches those
+  between group decodes (codecs/clay.py) — they are a 1/q fraction of
+  one row per aloof node, far too small to earn a kernel.
+- **Plane-blocked streaming.**  The round-7 kernels held the WHOLE
+  output chunk per grid step, capping ``sub_chunk_no * sc`` at the
+  1 Mi-lane VMEM scatter budget (a 1 MiB-chunk (8,4,d=11) repair —
+  the flagship geometry — already overflowed it).  Now every ref is a
+  2D ``(sb, lb)`` lane block with ``lb | sc``; the grid walks the
+  repair-plane lane space and the per-class index maps do the digit
+  arithmetic, so VMEM per step is ``refs * sb * lb`` bytes no matter
+  how large ``sub_chunk_no * sc`` grows.  ``supported()`` therefore
+  carries NO chunk-size cap any more — only lane alignment and a ref
+  budget.
+- **Any pair algebra.**  Coefficients are static Python ints baked
+  into the kernel as shift/mask peasant ladders on packed int32 lanes
+  (Mosaic cannot shift i8 vectors); the canonical RS(2,2) coupling
+  reduces to the one-step ``U = C ^ 2*(C_hi^C_lo)`` /
+  ``C = C_x ^ inv2*(C_x^U_x)`` fusions, anything else takes the
+  general ladder.  The old ``_canonical_pair_algebra`` routing gate
+  is gone.
+
+Geometry conventions (see codecs/clay.py): nodes live on a q x t
+grid; the lost node is (x_l, y_l); repair planes are the sub-chunks
+whose digit y_l equals x_l, indexed 0..r-1 in ascending plane order
+(r = sub_chunk_no / q).  Changing digit ``y`` of a repair plane by
+``delta`` moves its repair index by ``delta * stride(y)`` where
+``stride(y) = q ** #{y' > y, y' != y_l}`` — all static, which is what
+lets DMA index maps do every gather and scatter.
 """
 
 from __future__ import annotations
@@ -30,26 +58,53 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .pallas_encode import _emulate_i32_to_i8, _emulate_i8_to_i32
+from .pallas_encode import bitcast_i32_to_u8, bitcast_u8_to_i32
 
 SB = 8   # minimum stripes per block (sublane granularity)
-#: scatter-block lane budget: sb * sub_chunk_no * sc (the kernel's
-#: VMEM footprint scales with the FULL chunk, not one plane packet).
-#: Measured on v5e: 1 Mi lanes (SB=16, sub=64, sc=1024) compiles with
-#: headroom; 2 Mi OOMs scoped VMEM.
-MAX_SCATTER_LANES = 1 << 20
+#: per-grid-step VMEM budget in bytes across all refs: blocks are
+#: (sb, lb) u8 lanes; lb shrinks (halving, floor 128) until the step
+#: fits.  4 MiB leaves headroom beside the double-buffered pipeline.
+STEP_BYTES = 4 << 20
+#: ref-count cap: (t-1)*q*(q+1) in+out refs for the uncoupled kernel
+#: (each of the (t-1)*q helper rows is read once per companion digit
+#: class).  Mosaic compiles ~64 refs comfortably; wider geometries
+#: fall back to the XLA paths.
+MAX_REFS = 64
 
 
-def _pick_sb(b: int, row_lanes: int, budget: int) -> int:
-    """Largest block row count that divides the batch and keeps the
-    block (sb * row_lanes output lanes) within the measured VMEM
-    budget: 16 measured ~1 GB/s over 8 (fewer DMA grid steps)."""
-    for sb in (16, 8):
-        if b % sb == 0 and sb * row_lanes <= budget:
-            return sb
-    return SB
+def supported(b: int, sc: int, q: int, t: int) -> bool:
+    """Kernel preconditions: batch blocks on sublanes, plane packets
+    lane-align, and the ref fan-out stays within the Mosaic budget.
+    Unlike the round-7 kernels there is NO ``sub_chunk_no * sc`` cap:
+    blocks are fixed-size lane slices, so any chunk size streams."""
+    return (
+        b % SB == 0
+        and sc % 128 == 0
+        and q >= 2
+        and t >= 2
+        and (t - 1) * q * (q + 1) <= MAX_REFS
+    )
 
 
+def _pick_sb(b: int) -> int:
+    """16 measured ~1 GB/s over 8 on the round-7 kernels (fewer DMA
+    grid steps); fall back to the sublane minimum otherwise."""
+    return 16 if b % 16 == 0 else SB
+
+
+def _pick_lb(sc: int, n_refs: int, sb: int) -> int:
+    """Largest lane-block dividing ``sc`` that keeps one grid step's
+    resident refs within STEP_BYTES (halving preserves divisibility;
+    128 always divides sc per ``supported``)."""
+    lb = sc
+    while lb >= 256 and lb % 2 == 0 and n_refs * sb * lb > STEP_BYTES:
+        lb //= 2
+    if lb % 128 or n_refs * sb * lb > STEP_BYTES:
+        lb = 128
+    return lb
+
+
+# ------------------------------------------------------- packed GF ops
 def _mul2_i32(xi):
     """Per-byte GF(2^8)/0x11D multiply-by-2 on packed int32 lanes."""
     return (
@@ -67,236 +122,289 @@ def _div2_i32(xi):
     )
 
 
-def _u8_to_i32(x, interpret):
-    if interpret:
-        return _emulate_i8_to_i32(x)
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.bitcast(x, jnp.int32)
-
-
-def _i32_to_u8(p, interpret):
-    if interpret:
-        return _emulate_i32_to_i8(p).astype(jnp.uint8)
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.bitcast(p, jnp.int8).astype(jnp.uint8)
-
-
-def supported(b: int, sc: int, sub_chunk_no: int) -> bool:
-    """Batch must block on sublanes; plane packets must lane-align
-    and the FULL-CHUNK scatter block must fit the VMEM budget (bigger
-    sub-chunk counts or packets fall back to the XLA fast path)."""
-    return (
-        b % SB == 0
-        and sc % 128 == 0
-        and SB * sub_chunk_no * sc <= MAX_SCATTER_LANES
-    )
+def _mulc_i32(xi, c: int):
+    """Per-byte GF(2^8) multiply by the static constant ``c`` — the
+    shift/mask peasant ladder, bit-length many _mul2 steps."""
+    if c == 0:
+        return jnp.zeros_like(xi)
+    acc = None
+    cur = xi
+    cc = c
+    while cc:
+        if cc & 1:
+            acc = cur if acc is None else acc ^ cur
+        cc >>= 1
+        if cc:
+            cur = _mul2_i32(cur)
+    return acc
 
 
+def _pair_i32(a, b, c0: int, c1: int):
+    """``c0*a ^ c1*b`` with the canonical coupling coefficients fused
+    to single mul2/div2 steps.  ``a``/``b`` may be None (a statically
+    zero operand — shortened virtual nodes)."""
+    if a is None and b is None:
+        return None  # two virtual (zero) nodes pair to zero
+    if a is None:
+        return _mulc_i32(b, c1)
+    if b is None:
+        return _mulc_i32(a, c0)
+    if (c0, c1) == (1, 0):
+        return a
+    if (c0, c1) == (0, 1):
+        return b
+    if (c0, c1) == (3, 2):
+        return a ^ _mul2_i32(a ^ b)
+    if (c0, c1) == (2, 3):
+        return b ^ _mul2_i32(a ^ b)
+    if (c0, c1) == (143, 142):
+        return a ^ _div2_i32(a ^ b)
+    if (c0, c1) == (142, 143):
+        return b ^ _div2_i32(a ^ b)
+    return _mulc_i32(a, c0) ^ _mulc_i32(b, c1)
+
+
+# -------------------------------------------------- uncoupled solve (a)
 @functools.lru_cache(maxsize=64)
 def _uncoupled_fn(
-    rows: tuple[int, ...],
     q: int,
-    pvec_y: tuple[tuple[int, ...], ...],
-    swap_p: tuple[tuple[tuple[int, ...], ...], ...],
+    strides: tuple[int, ...],
+    kinds: tuple[tuple[str, ...], ...],
+    pair_fwd: tuple[tuple[int, int], tuple[int, int]],
+    r: int,
     sc: int,
     sb: int,
     interpret: bool,
 ):
-    """Stage-a kernel: (t-1)*q helper refs [B, P*sc] in, ONE stacked
-    uncoupled tensor [B, (t-1)*q, P*sc] out (the exact input form the
-    inner-MDS stacked matmul wants).
+    """Stage-a kernel builder.  One ref per (row, real member, digit
+    class) — q index-mapped views of each helper array — and one
+    ``[B, Mj, q, stride*sc]`` output per non-aloof member, so every
+    pair transform finds both operands resident without a gather.
 
-    ``pvec_y[ri][p]`` is plane p's digit for row rows[ri];
-    ``swap_p[ri][x][p]`` the companion plane index for node x."""
-    n_in = len(rows) * q
-    P = len(pvec_y[0])
-
-    # Greedy run merge: consecutive planes with the same digit class
-    # and contiguous companions collapse into one wide slice op (the
-    # minor free digit gives q-long runs — 4x fewer vector ops).
-    plans: list[list[tuple[int, int, int, int]]] = []
-    for ri in range(len(rows)):
+    ``strides[ri]`` is row ri's repair-index digit stride; ``kinds``
+    marks members 'r'eal / 'v'irtual (shortened, statically zero) /
+    'a'loof (no bytes; B2 classes emit the helper's C as the patch
+    placeholder); ``pair_fwd`` the (self, partner) coefficients for
+    the hi/lo pair member."""
+    n_rows = len(kinds)
+    in_plan: list[tuple[int, int, int]] = []   # (row, x, zv)
+    in_idx: dict[tuple[int, int, int], int] = {}
+    out_plan: list[tuple[int, int]] = []       # (row, x)
+    for ri in range(n_rows):
         for x in range(q):
-            runs = []
-            p = 0
-            while p < P:
-                zv = pvec_y[ri][p]
-                pp = swap_p[ri][x][p]
-                end = p + 1
-                while (
-                    end < P
-                    and pvec_y[ri][end] == zv
-                    and swap_p[ri][x][end] == pp + (end - p)
-                ):
-                    end += 1
-                runs.append((p, end, zv, pp))
-                p = end
-            plans.append(runs)
+            if kinds[ri][x] == "r":
+                for zv in range(q):
+                    in_idx[(ri, x, zv)] = len(in_plan)
+                    in_plan.append((ri, x, zv))
+            if kinds[ri][x] != "a":
+                out_plan.append((ri, x))
+    n_in = len(in_plan)
+    lb = _pick_lb(sc, n_in + len(out_plan) * q, sb)
 
     def kernel(*refs):
-        ins, out = refs[:n_in], refs[n_in]
-        xi = [_u8_to_i32(r[:], interpret) for r in ins]
-        for ri in range(len(rows)):
-            for x in range(q):
-                a32 = xi[ri * q + x]
-                for p0, p1, zv, pp in plans[ri * q + x]:
-                    a = a32[:, p0 * sc : p1 * sc]
-                    if zv == x:
-                        u = a
-                    else:
-                        b = xi[ri * q + zv][
-                            :, pp * sc : (pp + p1 - p0) * sc
-                        ]
-                        u = a ^ _mul2_i32(a ^ b)
-                    out[:, ri * q + x, p0 * sc : p1 * sc] = (
-                        _i32_to_u8(u, interpret)
+        ins, outs = refs[:n_in], refs[n_in:]
+        cache: dict[tuple[int, int, int], jax.Array] = {}
+
+        def block(ri, x, zv):
+            key = (ri, x, zv)
+            if key not in cache:
+                cache[key] = bitcast_u8_to_i32(
+                    ins[in_idx[key]][:], interpret
+                )
+            return cache[key]
+
+        for oi, (ri, x) in enumerate(out_plan):
+            for zv in range(q):
+                if zv == x:
+                    # dot plane: U = C (virtual: U = 0)
+                    if kinds[ri][x] == "v":
+                        outs[oi][:, 0, zv, :] = jnp.zeros(
+                            (sb, lb), jnp.uint8
+                        )
+                        continue
+                    u = block(ri, x, zv)
+                elif kinds[ri][x] == "r" and kinds[ri][zv] == "a":
+                    # B2 class: companion U is decoded later — emit C
+                    # as the placeholder the codec's patch consumes.
+                    u = block(ri, x, zv)
+                else:
+                    a = (
+                        block(ri, x, zv)
+                        if kinds[ri][x] == "r" else None
                     )
+                    bb = (
+                        block(ri, zv, x)
+                        if kinds[ri][zv] == "r" else None
+                    )
+                    c0, c1 = pair_fwd[0] if x > zv else pair_fwd[1]
+                    u = _pair_i32(a, bb, c0, c1)
+                    if u is None:  # virtual pair: statically zero
+                        outs[oi][:, 0, zv, :] = jnp.zeros(
+                            (sb, lb), jnp.uint8
+                        )
+                        continue
+                outs[oi][:, 0, zv, :] = bitcast_i32_to_u8(u, interpret)
 
     @jax.jit
     def apply(*helpers):
         b = helpers[0].shape[0]
-        return pl.pallas_call(
-            kernel,
-            grid=(b // sb,),
-            in_specs=[
-                pl.BlockSpec((sb, P * sc), lambda i: (i, 0))
-                for _ in range(n_in)
-            ],
-            out_specs=pl.BlockSpec(
-                (sb, n_in, P * sc), lambda i: (i, 0, 0)
-            ),
-            out_shape=jax.ShapeDtypeStruct(
-                (b, n_in, P * sc), jnp.uint8
-            ),
-            interpret=interpret,
-        )(*helpers)
-
-    return apply
-
-
-@functools.lru_cache(maxsize=64)
-def _couple_scatter_fn(
-    q: int,
-    x_l: int,
-    dst_p: tuple[tuple[int, ...], ...],
-    P: int,
-    sc: int,
-    sub_chunk_no: int,
-    sb: int,
-    interpret: bool,
-):
-    """Stage-c kernel: q-1 lost-row helper refs [B, P*sc] plus the
-    decoded lost-row U [B, q, P*sc] in, the recovered full chunk
-    [B, sub_chunk_no*sc] out. ``dst_p[x][p]`` is the absolute plane
-    each (row member x, repair plane p) pair produces."""
-
-    # Merge contiguous destination planes (get_repair_subchunks hands
-    # back runs, so the scatter is long contiguous lane stores).
-    runs_x: list[list[tuple[int, int, int]]] = []
-    for x in range(q):
-        runs = []
-        p = 0
-        while p < P:
-            z = dst_p[x][p]
-            end = p + 1
-            while end < P and dst_p[x][end] == z + (end - p):
-                end += 1
-            runs.append((p, end, z))
-            p = end
-        runs_x.append(runs)
-
-    def kernel(*refs):
-        helpers, udec, out = refs[: q - 1], refs[q - 1], refs[q]
+        in_specs = []
+        operands = []
+        helpers_by_rx = {}
         hi = 0
-        for x in range(q):
-            u32 = _u8_to_i32(udec[:, x, :], interpret)
-            if x == x_l:
-                for p0, p1, z in runs_x[x]:
-                    out[:, z * sc : (z + p1 - p0) * sc] = _i32_to_u8(
-                        u32[:, p0 * sc : p1 * sc], interpret
-                    )
-                continue
-            h32 = _u8_to_i32(helpers[hi][:], interpret)
-            hi += 1
-            for p0, p1, z in runs_x[x]:
-                a = h32[:, p0 * sc : p1 * sc]
-                b = u32[:, p0 * sc : p1 * sc]
-                out[:, z * sc : (z + p1 - p0) * sc] = _i32_to_u8(
-                    a ^ _div2_i32(a ^ b), interpret
-                )
-
-    @jax.jit
-    def apply(udec, *helpers):
-        b = udec.shape[0]
-        return pl.pallas_call(
+        for ri in range(n_rows):
+            for x in range(q):
+                if kinds[ri][x] == "r":
+                    helpers_by_rx[(ri, x)] = helpers[hi]
+                    hi += 1
+        for ri, x, zv in in_plan:
+            s = strides[ri]
+            spb = s * sc // lb
+            operands.append(helpers_by_rx[(ri, x)])
+            in_specs.append(pl.BlockSpec(
+                (sb, lb),
+                lambda bi, w, zv=zv, spb=spb: (
+                    bi, (w // spb) * (q * spb) + zv * spb + w % spb
+                ),
+            ))
+        out_specs = []
+        out_shapes = []
+        for ri, _x in out_plan:
+            s = strides[ri]
+            spb = s * sc // lb
+            mj = r // (q * s)
+            out_specs.append(pl.BlockSpec(
+                (sb, 1, q, lb),
+                lambda bi, w, spb=spb: (bi, w // spb, 0, w % spb),
+            ))
+            out_shapes.append(
+                jax.ShapeDtypeStruct((b, mj, q, s * sc), jnp.uint8)
+            )
+        outs = pl.pallas_call(
             kernel,
-            grid=(b // sb,),
-            in_specs=[
-                pl.BlockSpec((sb, P * sc), lambda i: (i, 0))
-                for _ in range(q - 1)
-            ]
-            + [pl.BlockSpec((sb, q, P * sc), lambda i: (i, 0, 0))],
-            out_specs=pl.BlockSpec(
-                (sb, sub_chunk_no * sc), lambda i: (i, 0)
-            ),
-            out_shape=jax.ShapeDtypeStruct(
-                (b, sub_chunk_no * sc), jnp.uint8
-            ),
+            grid=(b // sb, r * sc // (q * lb)),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
             interpret=interpret,
-        )(*helpers, udec)
+        )(*operands)
+        return [o.reshape(b, r * sc) for o in outs]
 
     return apply
 
 
 def uncoupled_rows(
-    rows: list[int],
     q: int,
-    pvec_y: list[list[int]],
-    swap_p,
+    strides: tuple[int, ...],
+    kinds: tuple[tuple[str, ...], ...],
+    pair_fwd,
     helpers: list,
+    r: int,
     sc: int,
     interpret: bool = False,
 ):
-    """helpers: (t-1)*q arrays [B, P*sc] (row-major, x within row).
-    Returns the stacked uncoupled tensor [B, (t-1)*q, P*sc]."""
+    """helpers: one [B, r*sc] array per REAL member, (row, x) order.
+    Returns one [B, r*sc] uncoupled-U array per non-aloof member in
+    the same order (virtual members included — the inner MDS counts
+    them as known rows; B2 classes hold the C placeholder)."""
     fn = _uncoupled_fn(
-        tuple(rows), q,
-        tuple(tuple(v) for v in pvec_y),
-        tuple(tuple(tuple(xs) for xs in r) for r in swap_p),
-        sc,
-        _pick_sb(
-            helpers[0].shape[0],
-            len(helpers) * len(pvec_y[0]) * sc,
-            2 * MAX_SCATTER_LANES,
-        ),
-        interpret,
+        q, tuple(strides),
+        tuple(tuple(row) for row in kinds),
+        (tuple(pair_fwd[0]), tuple(pair_fwd[1])),
+        r, sc, _pick_sb(helpers[0].shape[0]), interpret,
     )
     return fn(*helpers)
+
+
+# ---------------------------------------------- couple + scatter (c)
+@functools.lru_cache(maxsize=64)
+def _couple_scatter_fn(
+    q: int,
+    x_l: int,
+    kinds: tuple[str, ...],
+    pair_inv: tuple[tuple[int, int], tuple[int, int]],
+    seq: int,
+    r: int,
+    sc: int,
+    sb: int,
+    interpret: bool,
+):
+    """Stage-c kernel builder: the lost row's q decoded U arrays plus
+    its q-1 helper arrays in, the recovered chunk out.  Repair run j
+    (``seq`` consecutive repair planes) produces output planes
+    ``[j*q*seq, (j+1)*q*seq)`` — member x owns the x-th ``seq`` planes
+    of the run — so the output view ``[B, num_seq, q, seq*sc]`` makes
+    the whole scatter a rectangular block walk at any chunk size.
+
+    ``kinds[x]`` is 'r'/'v' for the helper members (x_l's slot is
+    ignored); ``pair_inv`` the (C_helper, U) coefficients recovering
+    the lost coupled value, hi/lo."""
+    helper_x = [
+        x for x in range(q) if x != x_l and kinds[x] == "r"
+    ]
+    n_in = q + len(helper_x)
+    lb = _pick_lb(sc, n_in + q, sb)
+    spb = seq * sc // lb
+    num_seq = r // seq
+    hidx = {x: q + i for i, x in enumerate(helper_x)}
+
+    def kernel(*refs):
+        ins, out = refs[:n_in], refs[n_in]
+        for x in range(q):
+            u = bitcast_u8_to_i32(ins[x][:], interpret)
+            if x == x_l:
+                o = u
+            else:
+                c0, c1 = pair_inv[0] if x > x_l else pair_inv[1]
+                h = (
+                    bitcast_u8_to_i32(ins[hidx[x]][:], interpret)
+                    if kinds[x] == "r" else None
+                )
+                o = _pair_i32(h, u, c0, c1)
+            out[:, 0, x, :] = bitcast_i32_to_u8(o, interpret)
+
+    @jax.jit
+    def apply(*arrs):
+        b = arrs[0].shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(b // sb, r * sc // lb),
+            in_specs=[
+                pl.BlockSpec((sb, lb), lambda bi, w: (bi, w))
+                for _ in range(n_in)
+            ],
+            out_specs=pl.BlockSpec(
+                (sb, 1, q, lb),
+                lambda bi, w: (bi, w // spb, 0, w % spb),
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (b, num_seq, q, seq * sc), jnp.uint8
+            ),
+            interpret=interpret,
+        )(*arrs).reshape(b, q * r * sc)
+
+    return apply
 
 
 def couple_scatter(
     q: int,
     x_l: int,
-    dst_p,
-    udec,
+    kinds,
+    pair_inv,
+    udec: list,
     helpers: list,
+    seq: int,
+    r: int,
     sc: int,
-    sub_chunk_no: int,
     interpret: bool = False,
 ):
-    """udec: [B, q, P*sc] decoded lost-row U; helpers: q-1 lost-row
-    helper arrays [B, P*sc] (ascending x, lost member absent).
-    Returns the recovered chunk [B, sub_chunk_no*sc]."""
-    P = len(dst_p[0])
+    """udec: q decoded lost-row U arrays [B, r*sc], ascending x;
+    helpers: the REAL lost-row helper arrays [B, r*sc], ascending x
+    with x_l and virtual members absent.  Returns the recovered chunk
+    [B, sub_chunk_no*sc]."""
     fn = _couple_scatter_fn(
-        q, x_l,
-        tuple(tuple(v) for v in dst_p),
-        P, sc, sub_chunk_no,
-        _pick_sb(
-            udec.shape[0], sub_chunk_no * sc, MAX_SCATTER_LANES
-        ),
-        interpret,
+        q, x_l, tuple(kinds),
+        (tuple(pair_inv[0]), tuple(pair_inv[1])),
+        seq, r, sc, _pick_sb(udec[0].shape[0]), interpret,
     )
-    return fn(udec, *helpers)
+    return fn(*udec, *helpers)

@@ -209,6 +209,28 @@ def _emulate_i8_to_i32(x):
     return jax.lax.bitcast_convert_type(xi, jnp.int32)
 
 
+def bitcast_u8_to_i32(x, interpret: bool):
+    """In-kernel sublane bitcast: [R, T] uint8 -> [R/4, T] int32 (4
+    sublane rows pack little-endian per lane).  The shared seam for
+    every kernel doing packed-byte GF arithmetic (clay_kernels, the
+    plane unpack below): interpret mode emulates the measured
+    hardware pack bit-exactly, so CPU CI covers the same math."""
+    if interpret:
+        return _emulate_i8_to_i32(x)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.bitcast(x, jnp.int32)
+
+
+def bitcast_i32_to_u8(p, interpret: bool):
+    """Inverse direction: [R, T] int32 -> [4R, T] uint8."""
+    if interpret:
+        return _emulate_i32_to_i8(p).astype(jnp.uint8)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.bitcast(p, jnp.int8).astype(jnp.uint8)
+
+
 def unpack_bitplanes(flat, interpret: bool):
     """In-kernel bit-plane unpack shared by the EC and CRC kernels.
 

@@ -114,7 +114,19 @@ def get_min_avail_to_read_shards(
         chosen = codec.minimum_to_decode_with_cost(
             want_raw, {sinfo.get_raw_shard(s): c for s, c in costs.items()}
         )
-        plan = {raw: [(0, codec.get_sub_chunk_count())] for raw in chosen}
+        # Re-plan over the cost-chosen survivors so sub-chunk
+        # selectors survive cost awareness: a CLAY single-shard
+        # repair restricted to the chosen helpers still reads only
+        # its repair planes (the cost-aware branch used to flatten
+        # every plan to full chunks, silently forfeiting the MSR
+        # read savings whenever a caller supplied costs).
+        try:
+            plan = codec.minimum_to_decode(want_raw, set(chosen))
+        except ValueError:
+            plan = {
+                raw: [(0, codec.get_sub_chunk_count())]
+                for raw in chosen
+            }
     else:
         plan = codec.minimum_to_decode(want_raw, avail_raw)
 
@@ -305,6 +317,12 @@ class ReadPipeline:
             .add_u64_counter("read_ops", "client reads submitted")
             .add_u64_counter("read_bytes", "client bytes returned")
             .add_u64_counter("reconstruct_ops", "reads that decoded")
+            .add_u64_counter(
+                "helper_read_bytes",
+                "bytes requested from shard stores by sub-reads (the "
+                "MSR observable: CLAY fractional repair keeps this "
+                "below the k-full-chunk bytes a naive decode reads)",
+            )
             .add_u64_counter("retries", "sub-read retries after errors")
             .add_u64_counter("errors", "reads failed after retry")
             .add_avg("read_lat", "submit-to-complete seconds")
@@ -372,6 +390,14 @@ class ReadPipeline:
     def _issue(self, op: ClientReadOp, reads: dict[int, ShardRead]) -> None:
         for shard in reads:
             op.pending[shard] = op.pending.get(shard, 0) + 1
+        self.perf.inc(
+            "helper_read_bytes",
+            sum(
+                end - start
+                for sr in reads.values()
+                for start, end in sr.extents
+            ),
+        )
         for sr in list(reads.values()):
             self.backend.read_shard_async(
                 sr.shard,

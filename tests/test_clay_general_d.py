@@ -1,0 +1,250 @@
+"""CLAY general-d Pallas repair kernels — the tier-1 gate for the
+plane-blocked kernel path (ops/clay_kernels.py, round 9).
+
+Pins, in interpret mode on CPU:
+
+- kernel-vs-host-GF bit equality for ALOOF geometries (d < k+m-1 —
+  the B1/B2 helper split with per-score-group decodes), including a
+  shortened one (virtual zero nodes inside an aloof row);
+- blocked streaming: a geometry whose ``SB * sub_chunk_no * sc``
+  overflows the retired 1 Mi-lane whole-chunk scatter budget repairs
+  through the kernels (the round-7 ``supported()`` refused it);
+- ``supported()`` envelope boundaries (no chunk-size cap, lane
+  alignment, ref budget);
+- the XLA fallback still matches bit-for-bit when the kernel path is
+  compile-gated off (``ec_clay_kernels=false``);
+- traced-vs-corpus equality: repairing every chunk of the archived
+  CLAY corpus entries through the kernels reproduces the frozen
+  bytes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codecs import registry
+from ceph_tpu.ops import clay_kernels
+from ceph_tpu.utils import config
+
+CORPUS_ROOT = os.path.join(os.path.dirname(__file__), "corpus")
+
+
+def make(**kv):
+    return registry.factory("clay", {k: str(v) for k, v in kv.items()})
+
+
+def encode_all(codec, rng, chunk_bytes):
+    import jax.numpy as jnp
+
+    k = codec.get_data_chunk_count()
+    data = rng.integers(0, 256, (k, chunk_bytes), dtype=np.uint8)
+    parity = codec.encode_chunks({i: jnp.asarray(data[i]) for i in range(k)})
+    chunks = {i: np.asarray(data[i]) for i in range(k)}
+    chunks.update({i: np.asarray(v) for i, v in parity.items()})
+    return chunks
+
+
+def repair_helpers(codec, chunks, lost, available, stripes, sc):
+    plan = codec.minimum_to_decode({lost}, set(available))
+    helper = {}
+    for node, ranges in plan.items():
+        one = np.concatenate([
+            chunks[node][idx * sc : (idx + cnt) * sc]
+            for idx, cnt in ranges
+        ])
+        helper[node] = np.broadcast_to(one, (stripes, one.size)).copy()
+    return helper
+
+
+def traced_repair(codec, helper, lost):
+    import jax
+    import jax.numpy as jnp
+
+    keys = sorted(helper)
+
+    @jax.jit
+    def traced(arrs):
+        return codec.repair({lost}, dict(zip(keys, arrs)))[lost]
+
+    return np.asarray(traced(tuple(jnp.asarray(helper[k]) for k in keys)))
+
+
+def run_geometry(k, m, d, sc, losts, stripes=8, drop_extra=None):
+    rng = np.random.default_rng(k * 100 + m * 10 + d)
+    codec = make(k=k, m=m, d=d)
+    Z = codec.get_sub_chunk_count()
+    chunks = encode_all(codec, rng, Z * sc)
+    n = k + m
+    for lost in losts:
+        available = set(range(n)) - {lost} - set(drop_extra or ())
+        available = sorted(available)[: d] if len(
+            available
+        ) > d else sorted(available)
+        if not codec.is_repair({lost}, set(available)):
+            available = sorted(set(range(n)) - {lost})[-d:]
+        helper = repair_helpers(
+            codec, chunks, lost, available, stripes, sc
+        )
+        host = np.asarray(
+            codec.repair({lost}, {i: v.copy() for i, v in helper.items()})
+            [lost]
+        )
+        dev = traced_repair(codec, helper, lost)
+        np.testing.assert_array_equal(
+            dev, host, err_msg=f"({k},{m},d={d}) lost={lost}"
+        )
+        np.testing.assert_array_equal(
+            dev, np.broadcast_to(chunks[lost], (stripes, Z * sc)),
+            err_msg=f"truth ({k},{m},d={d}) lost={lost}",
+        )
+
+
+class TestKernelsCalled:
+    def test_aloof_repair_rides_kernels(self, monkeypatch, rng):
+        """The general-d routing must actually reach the Pallas
+        kernels for an aloof geometry (not silently fall back)."""
+        calls = {"unc": 0, "scat": 0}
+        real_u = clay_kernels.uncoupled_rows
+        real_s = clay_kernels.couple_scatter
+        monkeypatch.setattr(
+            clay_kernels, "uncoupled_rows",
+            lambda *a, **kw: (
+                calls.__setitem__("unc", calls["unc"] + 1)
+                or real_u(*a, **kw)
+            ),
+        )
+        monkeypatch.setattr(
+            clay_kernels, "couple_scatter",
+            lambda *a, **kw: (
+                calls.__setitem__("scat", calls["scat"] + 1)
+                or real_s(*a, **kw)
+            ),
+        )
+        run_geometry(8, 4, 10, 128, losts=(3,))
+        assert calls == {"unc": 1, "scat": 1}
+
+
+class TestAloofGeometries:
+    def test_one_aloof_q3(self):
+        # (8,4,d=10): q=3, one aloof node, two score groups
+        run_geometry(8, 4, 10, 128, losts=(0, 7, 8, 11))
+
+    def test_one_aloof_shortened(self):
+        # (6,3,d=7): q=2, nu=1 — virtual zero nodes share rows with
+        # the aloof node
+        run_geometry(6, 3, 7, 128, losts=(0, 5, 6, 8))
+
+    def test_two_aloof(self):
+        # (8,4,d=9): q=2, TWO aloof nodes, three score groups
+        run_geometry(8, 4, 9, 128, losts=(0, 11))
+
+
+class TestBlockedStreaming:
+    def test_beyond_retired_vmem_budget(self):
+        """(4,2,d=5) at sc=32768: SB*sub_chunk_no*sc = 2 Mi lanes —
+        double the retired round-7 whole-chunk scatter budget; the
+        plane-blocked kernels stream it."""
+        assert clay_kernels.SB * 8 * 32768 > (1 << 20)
+        run_geometry(4, 2, 5, 32768, losts=(1, 5), stripes=8)
+
+    def test_lost_in_major_row_streams(self):
+        """y_l = 0 (one repair run spanning every plane) exercises the
+        lane-split scatter blocks rather than run-granular ones."""
+        run_geometry(8, 4, 11, 1024, losts=(0,), stripes=8)
+
+
+class TestSupported:
+    def test_no_chunk_size_cap(self):
+        # the retired gate: SB * sub_chunk_no * sc <= 1 Mi lanes
+        assert clay_kernels.supported(8, 1 << 20, 4, 3)
+
+    def test_boundaries(self):
+        assert clay_kernels.supported(8, 128, 2, 2)
+        assert not clay_kernels.supported(4, 128, 2, 2)   # batch % SB
+        assert not clay_kernels.supported(8, 129, 2, 2)   # lane align
+        assert not clay_kernels.supported(8, 64, 2, 2)    # sc < 128
+        assert not clay_kernels.supported(8, 128, 1, 3)   # q < 2
+        assert not clay_kernels.supported(8, 128, 2, 1)   # t < 2
+        # ref budget: (t-1)*q*(q+1) <= MAX_REFS
+        assert clay_kernels.supported(8, 128, 4, 4)       # 60 refs
+        assert not clay_kernels.supported(8, 128, 4, 5)   # 80 refs
+
+    def test_pick_lb_divides_and_bounds(self):
+        for sc in (128, 384, 1024, 65536):
+            lb = clay_kernels._pick_lb(sc, 40, 16)
+            assert sc % lb == 0 and lb % 128 == 0
+            assert lb == 128 or 40 * 16 * lb <= clay_kernels.STEP_BYTES
+
+
+class TestCompileGateFallback:
+    @pytest.mark.parametrize("k,m,d,lost", [(8, 4, 10, 3), (8, 4, 11, 9)])
+    def test_xla_fallback_matches_kernels(self, k, m, d, lost, rng):
+        """Regression: with the kernels compile-gated off, the traced
+        XLA paths (whole-tensor fast path and itemized aloof path)
+        still produce the identical chunk."""
+        codec = make(k=k, m=m, d=d)
+        Z = codec.get_sub_chunk_count()
+        sc = 128
+        chunks = encode_all(codec, rng, Z * sc)
+        available = sorted(set(range(k + m)) - {lost})[:d]
+        if not codec.is_repair({lost}, set(available)):
+            available = sorted(set(range(k + m)) - {lost})[-d:]
+        helper = repair_helpers(codec, chunks, lost, available, 8, sc)
+        with_kernels = traced_repair(codec, helper, lost)
+        with config.override(ec_clay_kernels=False):
+            without = traced_repair(codec, helper, lost)
+        np.testing.assert_array_equal(with_kernels, without)
+
+
+def _clay_corpus_entries():
+    import json
+
+    out = []
+    for version in sorted(os.listdir(CORPUS_ROOT)):
+        cdir = os.path.join(CORPUS_ROOT, version, "clay")
+        if not os.path.isdir(cdir):
+            continue
+        for slug in sorted(os.listdir(cdir)):
+            entry = os.path.join(cdir, slug)
+            meta = os.path.join(entry, "profile.json")
+            if os.path.isfile(meta):
+                with open(meta) as f:
+                    out.append((f"{version}-{slug}", entry, json.load(f)))
+    return out
+
+
+class TestTracedVsCorpus:
+    """Repairing every chunk of the archived CLAY corpus through the
+    kernels (interpret mode) reproduces the frozen bytes — the new
+    data path regresses against reference-derived vectors, not a
+    freeze of itself."""
+
+    @pytest.mark.parametrize(
+        "entry,meta",
+        [(e, m) for _id, e, m in _clay_corpus_entries()],
+        ids=[i for i, _e, _m in _clay_corpus_entries()],
+    )
+    def test_repair_matches_archive(self, entry, meta):
+        codec = registry.factory("clay", dict(meta["profile"]))
+        n = codec.get_chunk_count()
+        stored = {}
+        for i in range(n):
+            with open(os.path.join(entry, f"chunk.{i}"), "rb") as f:
+                stored[i] = np.frombuffer(f.read(), np.uint8)
+        Z = codec.get_sub_chunk_count()
+        sc = stored[0].size // Z
+        stripes = 8  # batch to clear the kernel's SB gate
+        for lost in (0, n - 1):
+            available = set(range(n)) - {lost}
+            if not codec.is_repair({lost}, available):
+                continue
+            helper = repair_helpers(
+                codec, stored, lost, sorted(available), stripes, sc
+            )
+            dev = traced_repair(codec, helper, lost)
+            np.testing.assert_array_equal(
+                dev,
+                np.broadcast_to(stored[lost], (stripes, stored[lost].size)),
+                err_msg=f"{entry} lost={lost}",
+            )
