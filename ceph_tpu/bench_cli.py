@@ -128,6 +128,10 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                     help="report small-op p99 from the device clock "
                          "(tunnel-RTT independent)")
     lg.add_argument("--seed", type=int, default=0xEC)
+    lg.add_argument("--coalesce", choices=["on", "off"], default="on",
+                    help="per-OSD-tick op coalescing (A/B flag: run "
+                         "the same spec both ways to measure what "
+                         "batching buys the live path)")
     lg.add_argument("--smoke", action="store_true",
                     help="tiny deterministic end-to-end run (CI "
                          "surface): smoke preset, 4 OSDs, one "
@@ -373,8 +377,22 @@ def _run_loadgen(args) -> tuple[float, float]:
                            osd=victim)
             )
         schedule = FaultSchedule(events)
+    from ceph_tpu.utils import config as _config
+
     try:
-        report = run_spec(cluster, spec, schedule)
+        with _config.override(
+            osd_op_coalescing=(args.coalesce == "on")
+        ):
+            report = run_spec(cluster, spec, schedule)
+        report["coalesce"] = args.coalesce
+        report["op_coalesced"] = sum(
+            d.coalesce_pc.get("op_coalesced")
+            for d in cluster.daemons.values()
+        )
+        report["subwrite_batches"] = sum(
+            d.coalesce_pc.get("subwrite_batches")
+            for d in cluster.daemons.values()
+        )
         if not report.get("exactly_once"):
             raise RuntimeError(
                 f"op accounting mismatch: issued {report['ops_in']} "

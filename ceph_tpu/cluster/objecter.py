@@ -10,6 +10,16 @@ a dead connection, or a map change that moves the object
 the map first and back off exponentially; terminal errors surface as
 exceptions (FileNotFoundError for enoent, IOError for eio).
 
+Submission is PIPELINED (the round-10 serving-tier rebuild): ops are
+enqueued without blocking the caller (``submit_async``), in-flight
+windows are tracked per OSD session, and completions flow back via
+callbacks/futures — the reference's op_submit never parks the caller
+either; it registers the op and lets the reply path finish it. The
+synchronous ``submit`` is a thin wait on the same engine, so a
+loadgen worker at queue depth ≫ 12 keeps the pipe full instead of
+lock-stepping request/reply, and the retry/backoff ladder runs on the
+objecter's timer thread instead of burning a caller thread per op.
+
 ``RadosClient``/``IoCtx`` mirror the librados surface
 (rados_write → IoCtxImpl::write → op_submit, librados_c.cc:1308):
 
@@ -21,9 +31,11 @@ exceptions (FileNotFoundError for enoent, IOError for eio).
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import threading
 import time
+from collections import deque
 
 from ceph_tpu.msg.messages import (
     NotifyAck,
@@ -42,10 +54,16 @@ class NoPrimary(Exception):
     degraded to serve — the reference client would block forever)."""
 
 
+#: per-OSD session flush sizes, log2 (1, 2, 4, ... 1024 ops)
+_BATCH_BUCKETS = [float(1 << i) for i in range(11)]
+
+
 def _client_perf(name: str):
     """Register the client-op counter set (Objecter.cc's
     l_osdc_* slice: active/inflight, completed, resent, failed —
-    plus a verify_failed slot loadgen's content checks feed)."""
+    plus a verify_failed slot loadgen's content checks feed, and the
+    session-coalescing pair the async engine reports: ops that left
+    in a multi-op window flush, and the flush-size histogram)."""
     from ceph_tpu.utils import PerfCountersBuilder, perf_collection
 
     return (
@@ -57,8 +75,66 @@ def _client_perf(name: str):
         .add_u64_counter(
             "verify_failed", "client-side content/csum mismatches"
         )
+        .add_u64_counter(
+            "op_coalesced",
+            "ops dispatched from a full session window's parked queue",
+        )
+        .add_histogram(
+            "batch_size", _BATCH_BUCKETS,
+            "per-OSD window occupancy at each flush (log2 buckets)",
+        )
         .create_perf_counters()
     )
+
+
+class _AsyncOp:
+    """One logical client op through the async engine: survives
+    resends (the osd_reqid_t identity), tracks the current attempt's
+    wire tid, and resolves its Completion exactly once."""
+
+    __slots__ = (
+        "pool", "oid", "op", "offset", "length", "data", "name",
+        "snap", "reqid", "completion", "on_complete", "attempt",
+        "ambiguous", "tid", "osd", "addr", "last", "trace",
+    )
+
+    def __init__(
+        self, pool, oid, op, offset, length, data, name, snap, reqid,
+        on_complete,
+    ) -> None:
+        self.pool = pool
+        self.oid = oid
+        self.op = op
+        self.offset = offset
+        self.length = length
+        self.data = data
+        self.name = name
+        self.snap = snap
+        self.reqid = reqid
+        self.completion = Completion()
+        self.on_complete = on_complete
+        self.attempt = 0          # attempts started so far
+        #: True once an attempt's outcome is unknown (timeout or lost
+        #: connection after send): the op may have applied without us
+        #: seeing the reply.
+        self.ambiguous = False
+        self.tid = 0              # current attempt's wire tid
+        self.osd = SHARD_NONE
+        self.addr = None
+        self.last = "no attempt made"
+        self.trace = (None, None)
+
+
+class _Session:
+    """Per-OSD in-flight window: tids on the wire plus the ops parked
+    behind the window (the reference's per-session op maps,
+    Objecter.h OSDSession)."""
+
+    __slots__ = ("inflight", "queue")
+
+    def __init__(self) -> None:
+        self.inflight: set[int] = set()
+        self.queue: deque[_AsyncOp] = deque()
 
 
 class Objecter:
@@ -73,11 +149,17 @@ class Objecter:
         backoff: float = 0.05,
         secret: bytes | None = None,
         perf_name: str | None = None,
+        max_inflight_per_osd: int | None = None,
     ) -> None:
         self.monitor = monitor
         self.max_attempts = max_attempts
         self.op_timeout = op_timeout
         self.backoff = backoff
+        if max_inflight_per_osd is None:
+            from ceph_tpu.utils import config
+
+            max_inflight_per_osd = config.get("objecter_inflight_per_osd")
+        self.max_inflight_per_osd = max_inflight_per_osd
         # client-side op counters (the objecter half of `perf dump`:
         # the reference's l_osdc_op_active/op_resend family). Opt-in
         # by name so ordinary clients stay registration-free; loadgen
@@ -101,11 +183,21 @@ class Objecter:
         self.client_id = uuid.uuid4().hex[:12]
         self._reqs = itertools.count(1)
         self._lock = threading.Lock()
-        self._waiting: dict[int, dict] = {}  # tid -> {event, reply}
+        #: wire tid -> _AsyncOp awaiting that attempt's reply
+        self._waiting: dict[int, _AsyncOp] = {}
+        #: osd id -> in-flight window + parked queue
+        self._sessions: dict[int, _Session] = {}
+        # timer machinery: one daemon thread drives retries (backoff
+        # ladder) and per-attempt deadlines, so no caller thread ever
+        # sleeps inside the engine
+        self._timers: list[tuple[float, int, str, _AsyncOp, int]] = []
+        self._timer_seq = itertools.count(1)
+        self._timer_cv = threading.Condition(self._lock)
+        self._timer_thread: threading.Thread | None = None
+        self._closed = False
         #: watch cookie -> callback(oid, payload)
         self._watch_cbs: dict[str, object] = {}
         self._watch_seq = itertools.count(1)
-        self._aio_executor = None
         #: ops resent so far (visible to tests: the resend contract)
         self.resends = 0
 
@@ -126,11 +218,9 @@ class Objecter:
             return
         if not isinstance(msg, OSDOpReply):
             return
-        with self._lock:
-            entry = self._waiting.get(msg.tid)
-        if entry is not None:
-            entry["reply"] = msg
-            entry["event"].set()
+        aop = self._take_waiting(msg.tid)
+        if aop is not None:
+            self._handle_reply(aop, msg)
 
     def _handle_watch_notify(self, conn: Connection, msg) -> None:
         """Watch event push from a primary: run the registered
@@ -148,7 +238,87 @@ class Objecter:
         except (ConnectionError, OSError):
             pass
 
+    # -- timer thread (retry ladder + attempt deadlines) ----------------
+    def _ensure_timer(self) -> None:
+        with self._lock:
+            if self._timer_thread is not None or self._closed:
+                return
+            self._timer_thread = threading.Thread(
+                target=self._timer_loop, daemon=True,
+                name="objecter-timer",
+            )
+            self._timer_thread.start()
+
+    def _at(self, when: float, kind: str, aop: _AsyncOp, tid: int) -> None:
+        with self._timer_cv:
+            heapq.heappush(
+                self._timers,
+                (when, next(self._timer_seq), kind, aop, tid),
+            )
+            self._timer_cv.notify()
+
+    def _timer_loop(self) -> None:
+        while True:
+            with self._timer_cv:
+                if self._closed:
+                    return
+                if not self._timers:
+                    self._timer_cv.wait(0.5)
+                    continue
+                when = self._timers[0][0]
+                now = time.monotonic()
+                if when > now:
+                    self._timer_cv.wait(min(when - now, 0.5))
+                    continue
+                _w, _s, kind, aop, tid = heapq.heappop(self._timers)
+            if kind == "retry":
+                self._start_attempt(aop)
+            else:  # attempt deadline
+                self._expire_attempt(aop, tid)
+
+    def _expire_attempt(self, aop: _AsyncOp, tid: int) -> None:
+        """Per-attempt deadline fired: if the attempt is still on the
+        wire, the reply is lost — ambiguous, retry. A reply that beat
+        the deadline already consumed the tid; do nothing then."""
+        if self._take_waiting(tid) is not aop:
+            return
+        aop.last = f"osd.{aop.osd} timed out"
+        aop.ambiguous = True
+        self._retry(aop)
+
     # -- op submission (the op_submit → _calc_target loop) --------------
+    def submit_async(
+        self,
+        pool: str,
+        oid: str,
+        op: str,
+        offset: int = 0,
+        length: int = 0,
+        data: bytes = b"",
+        name: str = "",
+        snap: int = 0,
+        on_complete=None,
+    ) -> "Completion":
+        """Enqueue one op without blocking: targeting, send, retries
+        and the per-attempt deadline all run off the caller's thread;
+        the returned Completion resolves when the op terminally
+        succeeds or fails (callback first, then waiters)."""
+        aop = _AsyncOp(
+            pool, oid, op, offset, length, bytes(data), name, snap,
+            f"{self.client_id}.{next(self._reqs)}", on_complete,
+        )
+        if self.perf is not None:
+            with self._lock:
+                self._inflight += 1
+                self.perf.set("op_inflight", self._inflight)
+        self._ensure_timer()
+        # the op's trace context is captured ONCE and rides every
+        # attempt (resends continue the same client trace)
+        with tracer.span("client_op", op=op, pool=pool, oid=oid):
+            aop.trace = tracer.current()
+            self._start_attempt(aop)
+        return aop.completion
+
     def submit(
         self,
         pool: str,
@@ -160,107 +330,176 @@ class Objecter:
         name: str = "",
         snap: int = 0,
     ) -> OSDOpReply:
-        reqid = f"{self.client_id}.{next(self._reqs)}"
+        """Synchronous facade over the async engine: submit + wait.
+        Raises the op's terminal error (FileNotFoundError, KeyError,
+        IOError, NoPrimary) exactly like the classic blocking loop."""
+        c = self.submit_async(
+            pool, oid, op, offset, length, data, name, snap
+        )
+        # generous cap: the engine already bounds every attempt with
+        # op_timeout and the ladder with max_attempts — this wait only
+        # guards against an engine bug wedging a caller forever
+        cap = self.max_attempts * (self.op_timeout + 1.0) + sum(
+            self.backoff * (2 ** a) for a in range(self.max_attempts)
+        ) + 30.0
+        return c.wait_for_complete(cap)
+
+    def _start_attempt(self, aop: _AsyncOp) -> None:
+        """Run one targeting + send attempt (caller thread for the
+        first, timer thread for retries). Never raises — every failure
+        either schedules a retry or resolves the completion."""
+        if self._closed:
+            self._resolve(aop, None, ConnectionError("objecter shut down"))
+            return
+        aop.attempt += 1
+        if aop.attempt > self.max_attempts:
+            self._resolve(aop, None, NoPrimary(
+                f"{aop.op} {aop.pool}/{aop.oid}: gave up after "
+                f"{self.max_attempts} attempts ({aop.last})"
+            ))
+            return
+        if aop.attempt > 1:
+            # count STARTED re-attempts (the classic loop's contract)
+            self.resends += 1
+            if self.perf is not None:
+                self.perf.inc("op_resend")
+        osdmap = self.monitor.osdmap  # refresh before each attempt
+        try:
+            if aop.op == "pgls":  # PG-addressed: offset carries pgid
+                primary = osdmap.pg_primary(aop.pool, aop.offset)
+            else:
+                primary = osdmap.primary(aop.pool, aop.oid)
+        except KeyError as e:
+            self._resolve(aop, None, FileNotFoundError(str(e)))
+            return
+        if primary == SHARD_NONE:
+            aop.last = "no live primary"
+            self._retry(aop)
+            return
+        addr = osdmap.get_addr(primary)
+        if addr is None:
+            aop.last = f"osd.{primary} has no address"
+            self._retry(aop)
+            return
+        aop.osd = primary
+        aop.addr = addr
+        tid = next(self._tids)
+        aop.tid = tid
+        with self._lock:
+            self._waiting[tid] = aop
+            sess = self._sessions.setdefault(primary, _Session())
+            if len(sess.inflight) >= self.max_inflight_per_osd:
+                # window full: park behind it — the completion of any
+                # in-flight op on this session pumps the queue
+                sess.queue.append(aop)
+                return
+            sess.inflight.add(tid)
+        self._send_attempt(aop)
+
+    def _send_attempt(self, aop: _AsyncOp) -> None:
+        try:
+            t_id, t_span = aop.trace
+            self._conn(aop.addr).send(
+                OSDOp(aop.tid, self.monitor.osdmap.epoch, aop.pool,
+                      aop.oid, aop.op, aop.offset, aop.length, aop.data,
+                      aop.name, reqid=aop.reqid, snap=aop.snap,
+                      trace_id=t_id, parent_span=t_span)
+            )
+        except (ConnectionError, OSError):
+            aop.last = f"osd.{aop.osd} connection failed"
+            aop.ambiguous = True  # the send may still have landed
+            self._take_waiting(aop.tid)
+            with self._lock:
+                self._conns.pop(aop.addr, None)
+            self._retry(aop)
+            return
+        self._at(
+            time.monotonic() + self.op_timeout, "deadline", aop, aop.tid
+        )
+
+    def _take_waiting(self, tid: int) -> "_AsyncOp | None":
+        """Consume one wire tid: unregister it and free its session
+        window slot, pumping parked ops into the freed slot.
+        ``op_coalesced`` counts ops dispatched FROM the parked queue
+        (they shared the session window with other in-flight ops by
+        definition) and ``batch_size`` histograms the window occupancy
+        at each flush — together they show whether the configured
+        queue depth actually reaches the wire."""
+        pump: list[_AsyncOp] = []
+        occupancy = 0
+        with self._lock:
+            aop = self._waiting.pop(tid, None)
+            if aop is None:
+                return None
+            sess = self._sessions.get(aop.osd)
+            if sess is not None:
+                sess.inflight.discard(tid)
+                while sess.queue and (
+                    len(sess.inflight) < self.max_inflight_per_osd
+                ):
+                    nxt = sess.queue.popleft()
+                    if nxt.tid not in self._waiting:
+                        continue  # retried/resolved while parked
+                    sess.inflight.add(nxt.tid)
+                    pump.append(nxt)
+                occupancy = len(sess.inflight)
+        if pump:
+            if self.perf is not None:
+                self.perf.inc("op_coalesced", len(pump))
+                self.perf.hinc("batch_size", occupancy)
+            for nxt in pump:
+                self._send_attempt(nxt)
+        return aop
+
+    def _retry(self, aop: _AsyncOp) -> None:
+        """Schedule the next attempt on the backoff ladder
+        (osdc/Objecter.cc resend-with-backoff)."""
+        delay = self.backoff * (2 ** max(aop.attempt - 1, 0))
+        self._ensure_timer()
+        self._at(time.monotonic() + delay, "retry", aop, aop.tid)
+
+    def _handle_reply(self, aop: _AsyncOp, reply: OSDOpReply) -> None:
+        if reply.error == "eagain":
+            aop.last = (
+                f"osd.{aop.osd} not primary (its epoch {reply.epoch})"
+            )
+            self._retry(aop)
+            return
+        if reply.error == "enoent":
+            if aop.op == "remove" and aop.ambiguous:
+                # The reqid dedup cache is primary-local; after a
+                # failover the new primary cannot replay the lost
+                # reply. When an earlier attempt's outcome is
+                # unknown, enoent on the resent remove means it
+                # already applied — the object is gone, which is
+                # what the caller asked for. (eagain-only retries
+                # stay unambiguous and surface enoent normally.)
+                self._resolve(aop, reply, None)
+                return
+            self._resolve(
+                aop, None, FileNotFoundError(f"{aop.pool}/{aop.oid}")
+            )
+            return
+        if reply.error == "enodata":
+            self._resolve(
+                aop, None, KeyError(f"{aop.pool}/{aop.oid}: no such xattr")
+            )
+            return
+        if reply.error == "eio":
+            self._resolve(aop, None, IOError(
+                reply.data.decode() or f"eio on {aop.pool}/{aop.oid}"
+            ))
+            return
+        self._resolve(aop, reply, None)
+
+    def _resolve(self, aop: _AsyncOp, reply, error) -> None:
         if self.perf is not None:
             with self._lock:
-                self._inflight += 1
+                self._inflight -= 1
                 self.perf.set("op_inflight", self._inflight)
-        try:
-            with tracer.span("client_op", op=op, pool=pool, oid=oid):
-                reply = self._submit_traced(
-                    pool, oid, op, offset, length, data, name, snap,
-                    reqid,
-                )
-            if self.perf is not None:
-                self.perf.inc("op_completed")
-            return reply
-        except Exception:
-            if self.perf is not None:
-                self.perf.inc("op_error")
-            raise
-        finally:
-            if self.perf is not None:
-                with self._lock:
-                    self._inflight -= 1
-                    self.perf.set("op_inflight", self._inflight)
-
-    def _submit_traced(
-        self, pool, oid, op, offset, length, data, name, snap, reqid
-    ) -> OSDOpReply:
-        last = "no attempt made"
-        # True once an attempt's outcome is unknown (timeout or lost
-        # connection after send): the op may have applied without us
-        # seeing the reply.
-        ambiguous = False
-        for attempt in range(self.max_attempts):
-            if attempt:
-                self.resends += 1
-                if self.perf is not None:
-                    self.perf.inc("op_resend")
-                time.sleep(self.backoff * (2 ** (attempt - 1)))
-            osdmap = self.monitor.osdmap  # refresh before each attempt
-            try:
-                if op == "pgls":  # PG-addressed: offset carries pgid
-                    primary = osdmap.pg_primary(pool, offset)
-                else:
-                    primary = osdmap.primary(pool, oid)
-            except KeyError as e:
-                raise FileNotFoundError(str(e)) from None
-            if primary == SHARD_NONE:
-                last = "no live primary"
-                continue
-            addr = osdmap.get_addr(primary)
-            if addr is None:
-                last = f"osd.{primary} has no address"
-                continue
-            tid = next(self._tids)
-            entry = {"event": threading.Event(), "reply": None}
-            with self._lock:
-                self._waiting[tid] = entry
-            try:
-                t_id, t_span = tracer.current()
-                self._conn(addr).send(
-                    OSDOp(tid, osdmap.epoch, pool, oid, op,
-                          offset, length, data, name, reqid=reqid,
-                          snap=snap, trace_id=t_id, parent_span=t_span)
-                )
-                if not entry["event"].wait(self.op_timeout):
-                    last = f"osd.{primary} timed out"
-                    ambiguous = True
-                    continue
-            except (ConnectionError, OSError):
-                last = f"osd.{primary} connection failed"
-                ambiguous = True  # the send may still have landed
-                with self._lock:
-                    self._conns.pop(addr, None)
-                continue
-            finally:
-                with self._lock:
-                    self._waiting.pop(tid, None)
-            reply: OSDOpReply = entry["reply"]
-            if reply.error == "eagain":
-                last = f"osd.{primary} not primary (its epoch {reply.epoch})"
-                continue
-            if reply.error == "enoent":
-                if op == "remove" and ambiguous:
-                    # The reqid dedup cache is primary-local; after a
-                    # failover the new primary cannot replay the lost
-                    # reply. When an earlier attempt's outcome is
-                    # unknown, enoent on the resent remove means it
-                    # already applied — the object is gone, which is
-                    # what the caller asked for. (eagain-only retries
-                    # stay unambiguous and surface enoent normally.)
-                    return reply
-                raise FileNotFoundError(f"{pool}/{oid}")
-            if reply.error == "enodata":
-                raise KeyError(f"{pool}/{oid}: no such xattr")
-            if reply.error == "eio":
-                raise IOError(reply.data.decode() or f"eio on {pool}/{oid}")
-            return reply
-        raise NoPrimary(
-            f"{op} {pool}/{oid}: gave up after {self.max_attempts} "
-            f"attempts ({last})"
-        )
+            self.perf.inc("op_error" if error is not None
+                          else "op_completed")
+        aop.completion._resolve(reply, error, aop.on_complete)
 
     def aio_submit(
         self,
@@ -272,38 +511,33 @@ class Objecter:
         data: bytes = b"",
         on_complete=None,
     ) -> Completion:
-        """Asynchronous submit (rados_aio_*): the full retry/resend
-        loop runs on a worker thread; the returned Completion fires
-        when the op terminally succeeds or fails."""
-        c = Completion()
-
-        def run() -> None:
-            try:
-                reply, err = self.submit(
-                    pool, oid, op, offset, length, data
-                ), None
-            except Exception as e:
-                reply, err = None, e
-            c._resolve(reply, err, on_complete)
-
-        self._aio_pool().submit(run)
-        return c
-
-    def _aio_pool(self):
-        """Shared bounded worker pool for aio ops (one thread per op
-        would be unbounded through retry/backoff loops)."""
-        with self._lock:
-            if self._aio_executor is None:
-                from concurrent.futures import ThreadPoolExecutor
-
-                self._aio_executor = ThreadPoolExecutor(
-                    max_workers=16, thread_name_prefix="objecter-aio"
-                )
-            return self._aio_executor
+        """Asynchronous submit (rados_aio_*): alias of ``submit_async``
+        kept for the librados-shaped surface; the returned Completion
+        fires when the op terminally succeeds or fails."""
+        return self.submit_async(
+            pool, oid, op, offset, length, data,
+            on_complete=on_complete,
+        )
 
     def shutdown(self) -> None:
-        if self._aio_executor is not None:
-            self._aio_executor.shutdown(wait=False)
+        with self._timer_cv:
+            self._closed = True
+            pending = list(self._waiting.values()) + [
+                a for s in self._sessions.values() for a in s.queue
+            ]
+            self._waiting.clear()
+            for s in self._sessions.values():
+                s.queue.clear()
+                s.inflight.clear()
+            self._timer_cv.notify_all()
+        t = self._timer_thread
+        if t is not None:
+            t.join(timeout=2.0)
+        for aop in pending:
+            # nobody may block forever on an op the engine abandoned
+            self._resolve(
+                aop, None, ConnectionError("objecter shut down")
+            )
         self.messenger.shutdown()
 
 
@@ -549,17 +783,23 @@ class IoCtx:
 
     def list_objects(self) -> list[str]:
         """rados ls: PGLS every PG through its primary (the reference
-        client iterates placement groups the same way)."""
+        client iterates placement groups the same way). The per-PG
+        scans go out as ONE pipelined async wave — the listing costs
+        max(PG round trips), not their sum."""
         import json as _json
 
         spec = self.objecter.monitor.osdmap.pools.get(self.pool)
         if spec is None:
             raise FileNotFoundError(f"no such pool: {self.pool!r}")
-        oids: set[str] = set()
-        for pgid in range(spec.pg_num):
-            reply = self.objecter.submit(
+        comps = [
+            self.objecter.submit_async(
                 self.pool, f"pg{pgid}", "pgls", offset=pgid
             )
+            for pgid in range(spec.pg_num)
+        ]
+        oids: set[str] = set()
+        for c in comps:
+            reply = c.wait_for_complete(self.objecter.op_timeout + 30)
             oids.update(_json.loads(reply.data.decode()))
         return sorted(oids)
 
@@ -569,6 +809,14 @@ class IoCtx:
     ) -> Completion:
         return self.objecter.aio_submit(
             self.pool, oid, "write", offset=offset, data=bytes(data),
+            on_complete=on_complete,
+        )
+
+    def aio_write_full(self, oid: str, data: bytes, on_complete=None
+                       ) -> Completion:
+        """Async full-object replace (rados_aio_write_full)."""
+        return self.objecter.aio_submit(
+            self.pool, oid, "writefull", data=bytes(data),
             on_complete=on_complete,
         )
 

@@ -40,6 +40,8 @@ from ceph_tpu.msg.messages import (
     ECSubRead,
     ECSubReadReply,
     ECSubWrite,
+    ECSubWriteBatch,
+    ECSubWriteBatchReply,
     ECSubWriteReply,
     GetAttrs,
     NotifyAck,
@@ -85,6 +87,93 @@ _MUTATING_OPS = frozenset(
     {"write", "remove", "setxattr", "rmxattr", "omapset", "rollback",
      "append", "truncate", "writefull"}
 )
+
+#: client ops the per-tick coalescer may batch: plain EC writes.
+#: Appends stay solo (their offset resolves against the PREVIOUS
+#: op's committed size, which a batch-mate could move); reads and
+#: metadata ops gain nothing from encode batching.
+_COALESCE_OPS = frozenset({"write", "writefull"})
+
+
+class _ClientOpItem:
+    """One queued client op as the mClock scheduler carries it:
+    callable (the classic serial path) but introspectable, so the
+    worker can recognize a RUN of coalescable writes and execute
+    them as one tick batch."""
+
+    __slots__ = ("daemon", "conn", "msg")
+
+    def __init__(self, daemon: "OSDDaemon", conn, msg) -> None:
+        self.daemon = daemon
+        self.conn = conn
+        self.msg = msg
+
+    def __call__(self) -> None:
+        self.daemon._run_client_op(self.conn, self.msg)
+
+    def coalescable(self) -> bool:
+        return self.msg.op in _COALESCE_OPS
+
+
+class _CoalCtx:
+    """Per-op state threaded through the coalesced batch's three
+    phases (serial prelude under the op lock -> concurrent per-PG
+    execution -> serial epilogue)."""
+
+    __slots__ = (
+        "conn", "msg", "spec", "pgid", "epoch", "pg", "w_offset",
+        "result_size", "attrs", "trunc_attrs", "done", "outcome",
+        "size",
+    )
+
+    def __init__(self, conn, msg, spec, pgid, epoch) -> None:
+        self.conn = conn
+        self.msg = msg
+        self.spec = spec
+        self.pgid = pgid
+        self.epoch = epoch
+        self.pg = None
+        self.w_offset = 0
+        self.result_size = 0
+        self.attrs = None
+        self.trunc_attrs = None
+        self.done: list = []
+        #: ("ok", None) | ("eio", detail: recorded under the reqid)
+        #: | ("exc", detail: NOT recorded — mirrors the serial path,
+        #: where an exception bypasses _record_completed)
+        self.outcome = None
+        self.size = 0
+
+
+#: coalesced tick-batch sizes, log2 (1, 2, 4, ... 1024 ops)
+_COAL_BUCKETS = [float(1 << i) for i in range(11)]
+
+
+def _coalesce_perf(name: str):
+    """The daemon's coalescing observability (`perf dump` section
+    ``osd.<id>.coalesce``): how many ops rode a multi-op tick batch,
+    the batch-size histogram, and the sub-write frames the per-peer
+    fan-out packing saved."""
+    from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+    return (
+        PerfCountersBuilder(perf_collection, name)
+        .add_u64_counter(
+            "op_coalesced", "client ops executed in a multi-op batch"
+        )
+        .add_histogram(
+            "batch_size", _COAL_BUCKETS,
+            "coalesced tick-batch size in ops (log2 buckets)",
+        )
+        .add_u64_counter(
+            "subwrite_batches", "multi-sub-write frames sent to peers"
+        )
+        .add_u64_counter(
+            "subwrite_batched_ops",
+            "sub-writes that shared a frame with at least one other",
+        )
+        .create_perf_counters()
+    )
 
 
 def make_loc(pool_id: int, oid: str) -> str:
@@ -408,6 +497,9 @@ class OSDDaemon:
         self.op_timeout = op_timeout
         self.local = ShardBackend(_AnyShardStores(self.store))
         self.peers = NetShardBackend({}, secret=secret)
+        #: coalescing observability + the sub-write frame-packing hook
+        self.coalesce_pc = _coalesce_perf(f"osd.{osd_id}.coalesce")
+        self.peers.on_subwrite_batch = self._on_subwrite_batch
         # stamp my map interval into every sub-write (replica fence)
         self.peers.interval_fn = lambda: (
             self.osdmap.epoch, self.osd_id
@@ -549,19 +641,66 @@ class OSDDaemon:
                     self._sched_cv.wait(wait)
                     continue
             _cls, fn = got
-            try:
-                fn()
-            except Exception as e:
-                # Op errors reply themselves deeper down; anything
-                # surfacing here is an unexpected pipeline fault —
-                # keep the worker alive but dump the gather ring so
-                # the verbose context survives (Log::dump_recent).
-                self.log.error(
-                    "unexpected worker exception:", type(e).__name__, e
-                )
-                from ceph_tpu.utils.log import root_log
+            batch, leftover = self._collect_coalesce(fn)
+            if batch is not None:
+                self._run_thunk(lambda: self._run_coalesced_batch(batch))
+            else:
+                self._run_thunk(fn)
+            if leftover is not None:
+                self._run_thunk(leftover)
 
-                root_log.dump_recent("osd worker exception")
+    def _run_thunk(self, fn) -> None:
+        try:
+            fn()
+        except Exception as e:
+            # Op errors reply themselves deeper down; anything
+            # surfacing here is an unexpected pipeline fault —
+            # keep the worker alive but dump the gather ring so
+            # the verbose context survives (Log::dump_recent).
+            self.log.error(
+                "unexpected worker exception:", type(e).__name__, e
+            )
+            from ceph_tpu.utils.log import root_log
+
+            root_log.dump_recent("osd worker exception")
+
+    def _collect_coalesce(self, fn):
+        """When the dequeued work is a coalescable client write and
+        op coalescing is on, drain the RUN of coalescable writes
+        queued behind it (the per-OSD-tick window: whatever an async
+        client put on the wire together executes together). Returns
+        (batch, leftover): batch None means run ``fn`` the classic
+        way; leftover is the first non-coalescable item pulled while
+        collecting, run after the batch in its dequeue position."""
+        from ceph_tpu.utils import config as _cfg
+
+        if not (
+            isinstance(fn, _ClientOpItem)
+            and fn.coalescable()
+            and _cfg.get("osd_op_coalescing")
+        ):
+            return None, None
+        items = [fn]
+        cap = _cfg.get("osd_coalesce_max")
+        leftover = None
+        while len(items) < cap:
+            with self._sched_cv:
+                got = self.scheduler.dequeue()
+            if got is None:
+                break
+            _c, nfn = got
+            if isinstance(nfn, _ClientOpItem) and nfn.coalescable():
+                items.append(nfn)
+            else:
+                leftover = nfn  # queue order: runs after the batch
+                break
+        if len(items) == 1:
+            return None, leftover
+        return items, leftover
+
+    def _on_subwrite_batch(self, n: int) -> None:
+        self.coalesce_pc.inc("subwrite_batches")
+        self.coalesce_pc.inc("subwrite_batched_ops", n)
 
     def _schedule(self, class_name: str, fn, cost: float = 1.0) -> None:
         with self._sched_cv:
@@ -1631,6 +1770,8 @@ class OSDDaemon:
                             ECSubWriteReply(msg.tid, msg.shard)
                         ),
                     )
+        elif isinstance(msg, ECSubWriteBatch):
+            self._handle_sub_write_batch(conn, msg)
         elif isinstance(msg, ECSubRead):
             with tracer.continue_trace(msg.trace_id, msg.parent_span):
                 with tracer.span(
@@ -1652,6 +1793,49 @@ class OSDDaemon:
             self._handle_client_op(conn, msg)
         elif isinstance(msg, NotifyAck):
             self._handle_notify_ack(msg)
+
+    def _handle_sub_write_batch(
+        self, conn: Connection, msg: ECSubWriteBatch
+    ) -> None:
+        """One frame, many sub-writes (the round-10 fan-out batching).
+        Every item passes the SAME gates the solo ECSubWrite path
+        runs — per-loc interval fence, ECInject consultation — and
+        applies independently: a fenced/stale item answers
+        committed=False in the batch reply without poisoning its
+        batch-mates; an injected drop simply stays un-acked (parked
+        at the sender, like a lost solo ack)."""
+        import types
+
+        from ceph_tpu.pipeline.inject import ec_inject
+
+        results: list[tuple[int, bool]] = []
+        for tid, shard, epoch, from_osd, txn in msg.items:
+            oids = txn.oids()
+            locs = list(dict.fromkeys(
+                split_shard_key(o)[0] for o in oids
+            )) or [""]
+            stamp = types.SimpleNamespace(epoch=epoch, from_osd=from_osd)
+            if not all(
+                self._sub_write_interval_ok(stamp, l) for l in locs
+            ):
+                results.append((tid, False))
+                continue
+            if ec_inject.test_write_error3(locs[0]):
+                # abort the daemon mid-batch (ECBackend.cc:922-926):
+                # nothing later applies, no reply — every un-acked
+                # item parks at the sender
+                threading.Thread(target=self.stop, daemon=True).start()
+                return
+            acked: list[bool] = []
+            with tracer.span(
+                "sub_write", osd=self.osd_id, shard=shard, tid=tid,
+            ):
+                self.local.submit_shard_txn(
+                    self.osd_id, txn, lambda a=acked: a.append(True)
+                )
+            if acked:
+                results.append((tid, True))
+        conn.send(ECSubWriteBatchReply(msg.tid, self.osd_id, results))
 
     def _handle_sub_read(self, conn: Connection, msg: ECSubRead) -> None:
         def reply(_shard, result) -> None:
@@ -1716,9 +1900,7 @@ class OSDDaemon:
             ).start()
             return
         cost = 1.0 + max(len(msg.data), msg.length) / 65536.0
-        self._schedule(
-            "client", lambda: self._run_client_op(conn, msg), cost
-        )
+        self._schedule("client", _ClientOpItem(self, conn, msg), cost)
 
     def _run_client_op(self, conn: Connection, msg: OSDOp) -> None:
         try:
@@ -1780,103 +1962,9 @@ class OSDDaemon:
             return self._op_notify(msg, client_oid)
         with self._op_lock:
             self._drain_req_flushes()
-            polled = None  # durability fan-out, shared consult->resolve
-            if msg.op in _MUTATING_OPS and msg.reqid:
-                cached = self._completed_ops.get(msg.reqid)
-                if cached is not None:
-                    return OSDOpReply(
-                        msg.tid, epoch, error=cached.error,
-                        size=cached.size, data=cached.data,
-                    )
-                # failover path: the replicated per-object window (the
-                # pg-log reqid role) survives the old primary — a
-                # resent append/write/truncate replays its recorded
-                # result instead of re-applying. A STORAGE-seeded
-                # entry must first prove durable: the dead primary may
-                # have stamped it on < k shards (never acked, not
-                # reconstructible) — replaying that as success loses
-                # the write (round-4 advisor finding).
-                pg0 = self._get_pg(msg.pool, pgid)
-                hit = next(
-                    (t for t in self._req_window(pg0, msg.oid)
-                     if t[0] == msg.reqid), None
-                )
-                if hit is not None:
-                    unv = self._req_unverified.get(msg.oid)
-                    if unv and msg.reqid in unv:
-                        # async fan-out: a cached verdict resolves
-                        # NOW; otherwise a poller thread is working
-                        # (or cooldown/budget defers one) and the op
-                        # parks in the client's retry loop — eagain,
-                        # never a multi-second wait on the op worker
-                        polled = self._take_or_spawn_poll(
-                            pg0, msg.oid
-                        )
-                        if polled is None:
-                            return OSDOpReply(
-                                msg.tid, epoch, error="eagain"
-                            )
-                        members = sum(
-                            1 for o in pg0.acting if o != SHARD_NONE
-                        )
-                        verdict = self._classify_req(
-                            polled[0], msg.reqid, pg0.rmw.sinfo.k,
-                            max(members - len(polled[0]), 0),
-                        )
-                    else:
-                        verdict = "durable"
-                    if verdict == "durable":
-                        if unv:
-                            unv.discard(msg.reqid)
-                        return OSDOpReply(msg.tid, epoch, size=hit[1])
-                    if verdict == "unknown":
-                        # unreachable members could still prove the
-                        # op durable — back off instead of guessing
-                        return OSDOpReply(
-                            msg.tid, epoch, error="eagain"
-                        )
-                    if verdict == "ambiguous":
-                        return OSDOpReply(
-                            msg.tid, epoch, error="eio",
-                            data=b"resent op is not durable and later "
-                                 b"writes exist (unfound analog)",
-                        )
-                    # "reapply": first attempt reached < k shards and
-                    # nothing newer exists anywhere — drop the seeded
-                    # entry and re-execute, healing the torn stripe.
-                    # An append re-applies at its ORIGINAL offset (the
-                    # recorded result size minus the payload), not the
-                    # current size a partial apply may have inflated.
-                    self.log.info(
-                        "op", msg.oid, "resend", msg.reqid,
-                        "not durable - re-applying"
-                    )
-                    self._req_windows[msg.oid] = [
-                        t for t in self._req_window(pg0, msg.oid)
-                        if t[0] != msg.reqid
-                    ]
-                    if unv:
-                        unv.discard(msg.reqid)
-                    if msg.op == "append":
-                        msg.op = "write"
-                        msg.offset = max(hit[1] - len(msg.data), 0)
-            pg = self._get_pg(msg.pool, pgid)
-            if msg.op in _MUTATING_OPS:
-                # settle storage-seeded reqid entries BEFORE anything
-                # reads this object's size or stamps its window: a
-                # torn never-acked write must be erased and rolled
-                # back, or an append would build on the inflated OI
-                # and a committed op's attr stamp would launder the
-                # entry to every shard (round-5 review finding)
-                if not self._resolve_unverified_reqs(
-                    pg, msg.oid, polled=polled
-                ):
-                    return OSDOpReply(msg.tid, epoch, error="eagain")
-                # copy-on-first-write after a pool snapshot: the head
-                # must be preserved as the newest snap's clone BEFORE
-                # any mutation lands (make_writeable role,
-                # osd/PrimaryLogPG.cc)
-                self._maybe_cow(pg, spec, msg.oid)
+            reply, pg = self._mutating_gate(msg, spec, pgid, epoch)
+            if reply is not None:
+                return reply
             if msg.op == "write":
                 return self._record_completed(msg, self._op_write(pg, msg))
             if msg.op == "append":
@@ -1938,6 +2026,379 @@ class OSDDaemon:
                 return self._op_omaplist(pg, msg)
             return OSDOpReply(msg.tid, epoch, error="eio",
                               data=f"bad op {msg.op!r}".encode())
+
+    # -- coalesced tick execution (the round-10 serving tier) ----------
+    # Concurrent client EC writes queued at this daemon execute as ONE
+    # tick batch: the bookkeeping prelude (dedup gate, durability
+    # settlement, COW, reqid-window stamping) runs SERIALLY under
+    # _op_lock exactly as the classic path would, then per-PG groups
+    # execute concurrently — encodes from different PGs share batched
+    # device dispatches through the streaming ring
+    # (pipeline/dispatcher.py), and every group's sub-writes stage per
+    # peer OSD and flush as one framed message (ECSubWriteBatch).
+    # Per-op error isolation: one op's failure (inject, codec fault,
+    # degraded read) replies eio for THAT op; batch-mates commit.
+
+    def _run_coalesced_batch(self, items: "list[_ClientOpItem]") -> None:
+        to_send: list[tuple] = []
+        pre: list[_CoalCtx] = []
+        for it in items:
+            msg = it.msg
+            epoch = self.osdmap.epoch
+            try:
+                spec = self.osdmap.pools.get(msg.pool)
+                if spec is None:
+                    to_send.append((it.conn, OSDOpReply(
+                        msg.tid, epoch, error="enoent")))
+                    continue
+                if self.osdmap.primary(msg.pool, msg.oid) != self.osd_id:
+                    to_send.append((it.conn, OSDOpReply(
+                        msg.tid, epoch, error="eagain")))
+                    continue
+                pgid = self.osdmap.object_to_pg(msg.pool, msg.oid)
+                # peering gate BEFORE the lock (the serial path's
+                # ordering): peering never needs the op worker
+                if not self._get_pg(msg.pool, pgid).peered.wait(
+                    timeout=5.0
+                ):
+                    to_send.append((it.conn, OSDOpReply(
+                        msg.tid, epoch, error="eagain")))
+                    continue
+                msg.oid = make_loc(spec.pool_id, msg.oid)
+                pre.append(_CoalCtx(it.conn, msg, spec, pgid, epoch))
+            except Exception as e:
+                to_send.append((it.conn, OSDOpReply(
+                    msg.tid, epoch, error="eio",
+                    data=str(e).encode())))
+        executed = 0
+        if pre:
+            with self._op_lock:
+                self._drain_req_flushes()
+                pending = pre
+                while pending:
+                    # one WAVE per distinct object: a second op on the
+                    # same object waits for its predecessor's commit
+                    # AND reqid-window stamp (the serial path's
+                    # ordering), so it defers to the next wave
+                    wave: list[_CoalCtx] = []
+                    deferred: list[_CoalCtx] = []
+                    seen: set[str] = set()
+                    for ctx in pending:
+                        if ctx.msg.oid in seen:
+                            deferred.append(ctx)
+                            continue
+                        seen.add(ctx.msg.oid)
+                        if not self._coalesce_prelude(ctx, to_send):
+                            continue
+                        wave.append(ctx)
+                    if wave:
+                        self._coalesce_execute(wave)
+                        for ctx in wave:
+                            to_send.append(
+                                (ctx.conn, self._coalesce_epilogue(ctx))
+                            )
+                        executed += len(wave)
+                    pending = deferred
+        if len(items) > 1:
+            self.coalesce_pc.inc("op_coalesced", executed)
+            self.coalesce_pc.hinc("batch_size", len(items))
+        for conn, reply in to_send:
+            try:
+                conn.send(reply)
+            except (ConnectionError, OSError):
+                pass  # client gone; its resend finds the answer cached
+
+    def _coalesce_prelude(
+        self, ctx: _CoalCtx, to_send: list
+    ) -> bool:
+        """Serial per-op prelude under _op_lock: the shared mutating
+        gate, then the write-shape bookkeeping the classic handlers
+        do before dispatch. False = the op answered here (gate reply
+        or prelude fault) and must not execute."""
+        msg = ctx.msg
+        try:
+            reply, pg = self._mutating_gate(
+                msg, ctx.spec, ctx.pgid, ctx.epoch
+            )
+        except Exception as e:
+            to_send.append((ctx.conn, OSDOpReply(
+                msg.tid, ctx.epoch, error="eio",
+                data=str(e).encode())))
+            return False
+        if reply is not None:
+            to_send.append((ctx.conn, reply))
+            return False
+        ctx.pg = pg
+        try:
+            cur = self._object_size(pg, msg.oid)  # prime on takeover
+            if msg.op == "write":
+                ctx.w_offset = msg.offset
+                ctx.result_size = max(cur, msg.offset + len(msg.data))
+                ctx.attrs = self._req_attr_for(
+                    pg, msg.oid, msg.reqid, ctx.result_size
+                )
+            else:  # writefull: write half stays reqid-unstamped (a
+                # crash between write and shrink must re-run both —
+                # see the serial handler), the truncate half carries
+                # the window. Window state is frozen for the whole
+                # batch (_op_lock held; all window mutations are in
+                # serial phases), so precomputing here is exact.
+                ctx.w_offset = 0
+                ctx.result_size = len(msg.data)
+                ctx.attrs = None
+                ctx.trunc_attrs = self._req_attr_for(
+                    pg, msg.oid, msg.reqid, len(msg.data)
+                )
+        except Exception as e:
+            to_send.append((ctx.conn, OSDOpReply(
+                msg.tid, ctx.epoch, error="eio",
+                data=str(e).encode())))
+            return False
+        return True
+
+    def _coalesce_execute(self, wave: "list[_CoalCtx]") -> None:
+        """Run one wave: per-PG groups execute concurrently, each
+        group pipelining its ops through the PG's RMW machinery.
+        Sub-writes stage per peer for the whole wave (one frame per
+        peer), encodes ride the streaming ring across groups."""
+        groups: dict[tuple, list[_CoalCtx]] = {}
+        for ctx in wave:
+            groups.setdefault(
+                (ctx.msg.pool, ctx.pgid), []
+            ).append(ctx)
+        with self.peers.subwrite_batching():
+            if len(groups) == 1:
+                self._coalesce_run_group(next(iter(groups.values())))
+            else:
+                threads = [
+                    threading.Thread(
+                        target=self._coalesce_run_group, args=(ctxs,),
+                        daemon=True,
+                        name=f"osd.{self.osd_id}-coal",
+                    )
+                    for ctxs in groups.values()
+                ]
+                for t in threads:
+                    t.start()
+                # drains inside each group are op_timeout-bounded, so
+                # the join only guards against a pathological stall
+                cap = self.op_timeout * (2 * len(wave)) + 10.0
+                for t in threads:
+                    t.join(timeout=cap)
+        for ctx in wave:
+            if ctx.outcome is None:
+                ctx.outcome = ("exc", "coalesced execution stalled")
+
+    def _coalesce_run_group(self, ctxs: "list[_CoalCtx]") -> None:
+        """One PG's slice of a wave, on its own thread. Writes
+        PIPELINE: every op submits before the first drain (the RMW
+        in-order commit machinery keeps tid order), so the group's
+        sub-writes share per-peer frames and its encodes overlap
+        other groups' in the ring."""
+        from ceph_tpu.pipeline import dispatcher as _disp
+
+        with _disp.coalescing_scope():
+            live: list[_CoalCtx] = []
+            for ctx in ctxs:
+                try:
+                    with tracer.continue_trace(
+                        ctx.msg.trace_id, ctx.msg.parent_span
+                    ), tracer.span(
+                        "osd_op", op=ctx.msg.op, oid=ctx.msg.oid,
+                        osd=self.osd_id, tid=ctx.msg.tid,
+                    ):
+                        ctx.pg.rmw.submit(
+                            ctx.msg.oid, ctx.w_offset, ctx.msg.data,
+                            on_commit=lambda op, c=ctx: c.done.append(op),
+                            extra_attrs=ctx.attrs,
+                        )
+                    live.append(ctx)
+                except Exception as e:
+                    ctx.outcome = ("exc", f"{type(e).__name__}: {e}")
+            self._coalesce_drain(live)
+            for ctx in list(live):
+                if ctx.done and ctx.done[0].error is not None:
+                    ctx.outcome = ("eio", str(ctx.done[0].error))
+                    live.remove(ctx)
+                elif not ctx.done:
+                    # drain timed out with the write still in flight:
+                    # stalled (a truncate queued behind it would only
+                    # deepen the wedge — the serial path raises here)
+                    live.remove(ctx)
+            # writefull second half: the shrink that makes the object
+            # exactly the payload (pipelined + drained the same way)
+            trunc = [c for c in live if c.msg.op == "writefull"]
+            for ctx in trunc:
+                ctx.done = []
+                try:
+                    ctx.pg.rmw.submit_truncate(
+                        ctx.msg.oid, len(ctx.msg.data),
+                        on_commit=lambda op, c=ctx: c.done.append(op),
+                        extra_attrs=ctx.trunc_attrs,
+                    )
+                except Exception as e:
+                    ctx.outcome = ("exc", f"{type(e).__name__}: {e}")
+                    live.remove(ctx)
+            self._coalesce_drain([c for c in trunc if c in live])
+            for ctx in list(live):
+                if ctx.done and ctx.done[0].error is not None:
+                    ctx.outcome = ("eio", str(ctx.done[0].error))
+                    live.remove(ctx)
+            for ctx in live:
+                if not ctx.done:
+                    continue  # drain timeout: outcome set by caller
+                ctx.size = (
+                    len(ctx.msg.data) if ctx.msg.op == "writefull"
+                    else ctx.pg.rmw.object_size(ctx.msg.oid)
+                )
+                ctx.outcome = ("ok", None)
+
+    def _coalesce_drain(self, ctxs: "list[_CoalCtx]") -> None:
+        if not ctxs:
+            return
+        try:
+            ctxs[0].pg.backend.drain_until(
+                lambda: all(bool(c.done) for c in ctxs),
+                timeout=self.op_timeout * (1 + len(ctxs)),
+            )
+        except TimeoutError:
+            pass  # un-done ops surface as stalled in the epilogue
+
+    def _coalesce_epilogue(self, ctx: _CoalCtx) -> OSDOpReply:
+        """Serial per-op completion under _op_lock: window commit,
+        backfill-dirty marking, reply + resend-replay recording —
+        the same tail the classic handlers run."""
+        msg, pg = ctx.msg, ctx.pg
+        kind, detail = ctx.outcome
+        if kind == "ok":
+            self._req_commit(pg, msg.oid, msg.reqid, ctx.result_size)
+            if pg.backfilling:
+                with self._pg_lock:
+                    pg.backfill_dirty.add(msg.oid)
+            return self._record_completed(
+                msg, OSDOpReply(msg.tid, ctx.epoch, size=ctx.size)
+            )
+        if kind == "eio":
+            return self._record_completed(
+                msg, OSDOpReply(msg.tid, ctx.epoch, error="eio",
+                                data=(detail or "").encode())
+            )
+        # "exc": mirrors the serial path's exception catch — replied
+        # eio but NOT recorded for resend replay
+        self.log.error(
+            "coalesced op", msg.op, msg.oid, "tid", msg.tid,
+            "failed:", detail,
+        )
+        return OSDOpReply(
+            msg.tid, ctx.epoch, error="eio",
+            data=(detail or "").encode(),
+        )
+
+    def _mutating_gate(
+        self, msg: OSDOp, spec, pgid: int, epoch: int
+    ) -> "tuple[OSDOpReply | None, _PG | None]":
+        """The dedup/durability gate every client op passes before its
+        handler (caller holds ``_op_lock``; shared by the serial and
+        the coalesced execution paths so they cannot diverge). Returns
+        ``(reply, pg)`` — a non-None reply short-circuits the op."""
+        polled = None  # durability fan-out, shared consult->resolve
+        if msg.op in _MUTATING_OPS and msg.reqid:
+            cached = self._completed_ops.get(msg.reqid)
+            if cached is not None:
+                return OSDOpReply(
+                    msg.tid, epoch, error=cached.error,
+                    size=cached.size, data=cached.data,
+                ), None
+            # failover path: the replicated per-object window (the
+            # pg-log reqid role) survives the old primary — a
+            # resent append/write/truncate replays its recorded
+            # result instead of re-applying. A STORAGE-seeded
+            # entry must first prove durable: the dead primary may
+            # have stamped it on < k shards (never acked, not
+            # reconstructible) — replaying that as success loses
+            # the write (round-4 advisor finding).
+            pg0 = self._get_pg(msg.pool, pgid)
+            hit = next(
+                (t for t in self._req_window(pg0, msg.oid)
+                 if t[0] == msg.reqid), None
+            )
+            if hit is not None:
+                unv = self._req_unverified.get(msg.oid)
+                if unv and msg.reqid in unv:
+                    # async fan-out: a cached verdict resolves
+                    # NOW; otherwise a poller thread is working
+                    # (or cooldown/budget defers one) and the op
+                    # parks in the client's retry loop — eagain,
+                    # never a multi-second wait on the op worker
+                    polled = self._take_or_spawn_poll(
+                        pg0, msg.oid
+                    )
+                    if polled is None:
+                        return OSDOpReply(
+                            msg.tid, epoch, error="eagain"
+                        ), None
+                    members = sum(
+                        1 for o in pg0.acting if o != SHARD_NONE
+                    )
+                    verdict = self._classify_req(
+                        polled[0], msg.reqid, pg0.rmw.sinfo.k,
+                        max(members - len(polled[0]), 0),
+                    )
+                else:
+                    verdict = "durable"
+                if verdict == "durable":
+                    if unv:
+                        unv.discard(msg.reqid)
+                    return OSDOpReply(msg.tid, epoch, size=hit[1]), None
+                if verdict == "unknown":
+                    # unreachable members could still prove the
+                    # op durable — back off instead of guessing
+                    return OSDOpReply(
+                        msg.tid, epoch, error="eagain"
+                    ), None
+                if verdict == "ambiguous":
+                    return OSDOpReply(
+                        msg.tid, epoch, error="eio",
+                        data=b"resent op is not durable and later "
+                             b"writes exist (unfound analog)",
+                    ), None
+                # "reapply": first attempt reached < k shards and
+                # nothing newer exists anywhere — drop the seeded
+                # entry and re-execute, healing the torn stripe.
+                # An append re-applies at its ORIGINAL offset (the
+                # recorded result size minus the payload), not the
+                # current size a partial apply may have inflated.
+                self.log.info(
+                    "op", msg.oid, "resend", msg.reqid,
+                    "not durable - re-applying"
+                )
+                self._req_windows[msg.oid] = [
+                    t for t in self._req_window(pg0, msg.oid)
+                    if t[0] != msg.reqid
+                ]
+                if unv:
+                    unv.discard(msg.reqid)
+                if msg.op == "append":
+                    msg.op = "write"
+                    msg.offset = max(hit[1] - len(msg.data), 0)
+        pg = self._get_pg(msg.pool, pgid)
+        if msg.op in _MUTATING_OPS:
+            # settle storage-seeded reqid entries BEFORE anything
+            # reads this object's size or stamps its window: a
+            # torn never-acked write must be erased and rolled
+            # back, or an append would build on the inflated OI
+            # and a committed op's attr stamp would launder the
+            # entry to every shard (round-5 review finding)
+            if not self._resolve_unverified_reqs(
+                pg, msg.oid, polled=polled
+            ):
+                return OSDOpReply(msg.tid, epoch, error="eagain"), None
+            # copy-on-first-write after a pool snapshot: the head
+            # must be preserved as the newest snap's clone BEFORE
+            # any mutation lands (make_writeable role,
+            # osd/PrimaryLogPG.cc)
+            self._maybe_cow(pg, spec, msg.oid)
+        return None, pg
 
     def _record_completed(self, msg: OSDOp, reply: OSDOpReply) -> OSDOpReply:
         """Remember a mutation's outcome under its client reqid so a

@@ -16,9 +16,23 @@ reports the end-to-end service numbers next to the kernel ones:
   kernel encode rate — the tax the whole service stack levies on the
   raw codec (client, sockets, daemon locks, store writes, checksums)
 
-Sized by ``CEPH_TPU_BENCH_CLUSTER_OPS`` (default 240 ops over 48
-256-KiB objects at queue depth 12 — a few-minute phase through a
-degraded tunnel, seconds locally)."""
+Round 10 adds the serving-tier observables:
+
+- the main leg runs at qd ≫ 12 with zipfian popularity through the
+  ASYNC objecter + per-tick op coalescing, and a second leg in the
+  SAME run with ``osd_op_coalescing=false`` pins the A/B:
+  ``cluster_gbps_nocoal`` / ``cluster_vs_kernel_frac_nocoal`` /
+  ``cluster_coalesce_speedup``;
+- a scaling row: ``cluster_scale_osd<N>_gbps`` / ``_iops`` legs over
+  OSD counts, and ``cluster_scale_chips<C>_gbps`` / ``_iops`` legs
+  with the dispatch mesh installed over C devices (the chip axis) —
+  GB/s and IOPS vs OSD count / chip count in one run.
+
+Sized by ``CEPH_TPU_BENCH_CLUSTER_OPS`` (default 240 ops at queue
+depth ``CEPH_TPU_BENCH_CLUSTER_QD`` = 32 over
+``CEPH_TPU_BENCH_CLUSTER_OBJECTS`` = 256 objects of 256 KiB; tunnel
+sessions raise the env vars — thousands of objects — without code
+edits). Scaling legs run at half the main leg's ops each."""
 
 from __future__ import annotations
 
@@ -29,41 +43,74 @@ from .driver import run_spec
 from .faults import FaultEvent, FaultSchedule
 from .spec import WorkloadSpec
 
+_MIX = {
+    "seq_write": 2, "rand_write": 1, "read": 3,
+    "reconstruct_read": 1, "rmw_overwrite": 1,
+}
 
-def measure_cluster(result: dict, enc_gbps: float) -> None:
-    total_ops = int(
-        os.environ.get("CEPH_TPU_BENCH_CLUSTER_OPS", "240")
-    )
+
+def _leg(
+    total_ops: int,
+    qd: int,
+    max_objects: int,
+    *,
+    n_osds: int = 6,
+    k: int = 4,
+    m: int = 2,
+    faults: bool = False,
+    device_clock: bool = False,
+    use_mesh: bool = False,
+    mesh_devices: int | None = None,
+    seed: int = 0xEC,
+) -> dict:
     cluster = LoadCluster(
-        n_osds=6, k=4, m=2, pg_num=8, chunk_size=16384,
+        n_osds=n_osds, k=k, m=m, pg_num=8, chunk_size=16384,
+        use_mesh=use_mesh, mesh_devices=mesh_devices,
     )
     try:
         spec = WorkloadSpec(
-            mix={
-                "seq_write": 2, "rand_write": 1, "read": 3,
-                "reconstruct_read": 1, "rmw_overwrite": 1,
-            },
+            mix=dict(_MIX),
             object_size=256 * 1024,
-            max_objects=48,
-            queue_depth=12,
+            max_objects=max_objects,
+            queue_depth=qd,
             total_ops=total_ops,
             warmup_ops=max(total_ops // 10, 8),
             popularity="zipfian",
-            device_clock=True,
+            device_clock=device_clock,
+            seed=seed,
         )
-        faults = FaultSchedule(
-            [
-                FaultEvent(at_op=total_ops // 3, action="kill"),
-                FaultEvent(at_op=(2 * total_ops) // 3,
-                           action="revive"),
-            ]
-        )
-        report = run_spec(cluster, spec, faults)
+        schedule = None
+        if faults:
+            schedule = FaultSchedule(
+                [
+                    FaultEvent(at_op=total_ops // 3, action="kill"),
+                    FaultEvent(at_op=(2 * total_ops) // 3,
+                               action="revive"),
+                ]
+            )
+        return run_spec(cluster, spec, schedule)
     finally:
         cluster.shutdown()
 
+
+def measure_cluster(result: dict, enc_gbps: float) -> None:
+    from ceph_tpu.utils import config
+
+    total_ops = int(
+        os.environ.get("CEPH_TPU_BENCH_CLUSTER_OPS", "240")
+    )
+    qd = int(os.environ.get("CEPH_TPU_BENCH_CLUSTER_QD", "32"))
+    max_objects = int(
+        os.environ.get("CEPH_TPU_BENCH_CLUSTER_OBJECTS", "256")
+    )
+    report = _leg(
+        total_ops, qd, max_objects, faults=True, device_clock=True
+    )
+
     result["cluster_gbps"] = report["gbps"]
     result["cluster_iops"] = report["iops"]
+    result["cluster_qd"] = qd
+    result["cluster_objects"] = max_objects
     if "lat_p99_ms" in report:
         result["cluster_p99_host_ms"] = report["lat_p99_ms"]
         # device-clock p99 when the probe succeeded (VERDICT weak #6:
@@ -82,9 +129,49 @@ def measure_cluster(result: dict, enc_gbps: float) -> None:
     result["cluster_recovered"] = bool(report.get("recovered"))
     if enc_gbps:
         # the kernel-vs-cluster efficiency ratio: how much of the raw
-        # codec rate survives the full service path (tiny by design
-        # today — this row exists to be watched, 8 decimals so a
+        # codec rate survives the full service path (8 decimals so a
         # Python-socket-tier number doesn't round to zero)
         result["cluster_vs_kernel_frac"] = round(
             report["gbps"] / enc_gbps, 8
         )
+
+    # -- A/B: the same workload with coalescing OFF, in the same run
+    # (the acceptance comparison is within-run, not across BENCH
+    # files — tunnel RTT drifts between sessions)
+    with config.override(osd_op_coalescing=False):
+        off = _leg(total_ops, qd, max_objects, seed=0xEC0FF)
+    result["cluster_gbps_nocoal"] = off["gbps"]
+    result["cluster_iops_nocoal"] = off["iops"]
+    if enc_gbps:
+        result["cluster_vs_kernel_frac_nocoal"] = round(
+            off["gbps"] / enc_gbps, 8
+        )
+    if off["gbps"]:
+        result["cluster_coalesce_speedup"] = round(
+            report["gbps"] / off["gbps"], 4
+        )
+
+    # -- scaling rows: GB/s and IOPS vs OSD count, then vs chip count
+    # (dispatch mesh over C devices). Half-length legs, no faults.
+    scale_ops = max(total_ops // 2, 40)
+    for n_osds in (6, 9, 12):
+        rep = _leg(
+            scale_ops, qd, max_objects, n_osds=n_osds,
+            seed=0x5CA1E + n_osds,
+        )
+        result[f"cluster_scale_osd{n_osds}_gbps"] = rep["gbps"]
+        result[f"cluster_scale_osd{n_osds}_iops"] = rep["iops"]
+    import jax
+
+    n_dev = len(jax.devices())
+    chip_legs = sorted(
+        {c for c in (1, 2, 4, n_dev) if 1 <= c <= n_dev}
+    )
+    for chips in chip_legs:
+        rep = _leg(
+            scale_ops, qd, max_objects,
+            use_mesh=chips > 1, mesh_devices=chips if chips > 1 else None,
+            seed=0xC41B + chips,
+        )
+        result[f"cluster_scale_chips{chips}_gbps"] = rep["gbps"]
+        result[f"cluster_scale_chips{chips}_iops"] = rep["iops"]

@@ -21,14 +21,16 @@ from dataclasses import dataclass, field
 class FaultEvent:
     #: fire once the run's completed-op counter reaches this
     at_op: int
-    #: "kill" | "revive"
+    #: "kill" | "revive" | "dcn_kill" (hard-kill a DCN host process
+    #: mid-run — the multi-chip msgr fault; ``osd`` carries the host
+    #: rank, default 1)
     action: str
     #: target osd id; None = pick (kill: first live non-mon victim
     #: in id order for determinism; revive: oldest corpse)
     osd: int | None = None
 
     def __post_init__(self) -> None:
-        if self.action not in ("kill", "revive"):
+        if self.action not in ("kill", "revive", "dcn_kill"):
             raise ValueError(f"unknown fault action {self.action!r}")
 
 
@@ -45,6 +47,7 @@ class FaultSchedule:
         self.kill_at: float | None = None      # monotonic stamps
         self.revive_at: float | None = None
         self.recovered_at: float | None = None
+        self.dcn_killed_at: float | None = None
         self.killed: list[int] = []
 
     def maybe_fire(self, ops_done: int, cluster) -> None:
@@ -62,6 +65,10 @@ class FaultSchedule:
                 self._apply(ev, cluster)
 
     def _apply(self, ev: FaultEvent, cluster) -> None:
+        if ev.action == "dcn_kill":
+            cluster.kill_dcn_host(1 if ev.osd is None else ev.osd)
+            self.dcn_killed_at = time.monotonic()
+            return
         if ev.action == "kill":
             osd = ev.osd
             if osd is None:

@@ -73,6 +73,12 @@ class WorkloadSpec:
     #: measure small-op latency on the device clock (tunnel-RTT
     #: independent percentiles — see recorder.DeviceClock)
     device_clock: bool = False
+    #: pipelined submission (round-10): a few issuer threads keep up
+    #: to ``queue_depth`` ASYNC ops on the wire through the objecter's
+    #: completion engine, instead of one blocking thread per depth
+    #: slot — queue depth actually reaches the wire at qd ≫ 12.
+    #: False restores the classic one-thread-per-slot closed loop.
+    async_submit: bool = True
 
     def __post_init__(self) -> None:
         for name in self.mix:

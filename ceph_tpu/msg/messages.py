@@ -45,6 +45,8 @@ MSG_PG_ACTIVATE = 127         # interval activation (les push)
 MSG_PG_ACTIVATE_ACK = 128
 MSG_BACKFILL_RESERVE = 129    # MBackfillReserve (request/release)
 MSG_BACKFILL_RESERVE_REPLY = 130
+MSG_EC_SUB_WRITE_BATCH = 131        # one frame, many sub-writes
+MSG_EC_SUB_WRITE_BATCH_REPLY = 132
 
 VERSION = 1
 
@@ -119,6 +121,84 @@ class ECSubWriteReply:
     def decode(cls, segments: list[bytes]) -> "ECSubWriteReply":
         h = _parse(segments[0], "sub_write_reply")
         return cls(h["tid"], h["shard"], h["committed"])
+
+
+@dataclass
+class ECSubWriteBatch:
+    """A tick's worth of sub-writes for ONE peer OSD in one framed
+    message (the round-10 fan-out batching): the primary's coalesced
+    op batch stages every sub-write destined for a peer and flushes
+    them together, so N concurrent client ops cost one frame per peer
+    instead of N. Each item keeps its own tid, logical shard, and
+    interval stamp — the receiver fences and applies items
+    INDEPENDENTLY (one stale item must not poison its batch-mates)
+    and answers with per-item outcomes in one reply frame.
+
+    ``tid`` is the batch's own wire id (reply routing only); item
+    tids are the sub-write tids the sender's pending table knows."""
+
+    tid: int
+    shard: int  # echo key for reply routing (the peer's osd id)
+    #: (tid, shard, epoch, from_osd, txn) per sub-write
+    items: list = field(default_factory=list)
+
+    def encode(self) -> list[bytes]:
+        blobs = [txn.to_bytes() for *_m, txn in self.items]
+        return [
+            _header(
+                "sub_write_batch",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "items": [
+                        list(meta) for *meta, _txn in self.items
+                    ],
+                    "lens": [len(b) for b in blobs],
+                },
+            ),
+            b"".join(blobs),
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "ECSubWriteBatch":
+        h = _parse(segments[0], "sub_write_batch")
+        blob, pos, items = segments[1], 0, []
+        for meta, ln in zip(h["items"], h["lens"]):
+            txn = Transaction.from_bytes(blob[pos : pos + ln])
+            pos += ln
+            items.append(tuple(meta) + (txn,))
+        return cls(h["tid"], h["shard"], items)
+
+
+@dataclass
+class ECSubWriteBatchReply:
+    """Per-item outcomes for one ECSubWriteBatch: (tid, committed)
+    pairs. Items the receiver never acked (injected drop, abort) are
+    simply absent — the sender's pending entries expire exactly like
+    a lost single-sub-write ack."""
+
+    tid: int
+    shard: int
+    results: list = field(default_factory=list)  # (tid, committed)
+
+    def encode(self) -> list[bytes]:
+        return [
+            _header(
+                "sub_write_batch_reply",
+                {
+                    "tid": self.tid,
+                    "shard": self.shard,
+                    "results": [list(r) for r in self.results],
+                },
+            )
+        ]
+
+    @classmethod
+    def decode(cls, segments: list[bytes]) -> "ECSubWriteBatchReply":
+        h = _parse(segments[0], "sub_write_batch_reply")
+        return cls(
+            h["tid"], h["shard"], [tuple(r) for r in h["results"]]
+        )
 
 
 @dataclass
@@ -825,6 +905,8 @@ _DECODERS = {
     MSG_PG_ACTIVATE_ACK: PGActivateAck.decode,
     MSG_BACKFILL_RESERVE: BackfillReserve.decode,
     MSG_BACKFILL_RESERVE_REPLY: BackfillReserveReply.decode,
+    MSG_EC_SUB_WRITE_BATCH: ECSubWriteBatch.decode,
+    MSG_EC_SUB_WRITE_BATCH_REPLY: ECSubWriteBatchReply.decode,
 }
 
 _TYPE_OF = {
@@ -851,6 +933,8 @@ _TYPE_OF = {
     PGActivateAck: MSG_PG_ACTIVATE_ACK,
     BackfillReserve: MSG_BACKFILL_RESERVE,
     BackfillReserveReply: MSG_BACKFILL_RESERVE_REPLY,
+    ECSubWriteBatch: MSG_EC_SUB_WRITE_BATCH,
+    ECSubWriteBatchReply: MSG_EC_SUB_WRITE_BATCH_REPLY,
 }
 
 

@@ -37,6 +37,8 @@ from .messages import (
     ECSubRead,
     ECSubReadReply,
     ECSubWrite,
+    ECSubWriteBatch,
+    ECSubWriteBatchReply,
     ECSubWriteReply,
     BackfillReserve,
     BackfillReserveReply,
@@ -101,6 +103,21 @@ class ShardServer:
                             ECSubWriteReply(msg.tid, self.shard)
                         ),
                     )
+        elif isinstance(msg, ECSubWriteBatch):
+            results = []
+            for tid, shard, _epoch, _from, txn in msg.items:
+                acked: list[bool] = []
+                with tracer.span(
+                    "sub_write", shard=self.shard, tid=tid,
+                ):
+                    self._local.submit_shard_txn(
+                        self.shard, txn, lambda a=acked: a.append(True)
+                    )
+                if acked:  # injected drops stay un-acked (parked)
+                    results.append((tid, True))
+            conn.send(
+                ECSubWriteBatchReply(msg.tid, self.shard, results)
+            )
         elif isinstance(msg, ECSubRead):
             from ceph_tpu.pipeline.extents import ExtentSet
 
@@ -186,6 +203,19 @@ class NetShardBackend:
         self._last_seen: dict[int, float] = {}
         self._hb_stop: threading.Event | None = None
         self._hb_thread: threading.Thread | None = None
+        # -- sub-write batching (round-10 fan-out coalescing): inside
+        # a ``subwrite_batching`` scope, sub-writes stage per peer and
+        # flush as ONE ECSubWriteBatch frame each. Flush points:
+        # scope exit, and the top of every drain_until loop — every
+        # submitter drains right after its fan-out, so a staged txn
+        # is never more than one drain iteration from the wire (and
+        # any concurrent thread's drain carries it along).
+        self._stage_depth = 0
+        self._staged: dict[int, list] = {}
+        #: observability hook the owning daemon points at its
+        #: coalesce counters: called with the item count of every
+        #: multi-sub-write frame sent
+        self.on_subwrite_batch: Callable[[int], None] | None = None
 
     # -- plumbing ------------------------------------------------------
     def _conn(self, shard: int) -> Connection:
@@ -203,6 +233,21 @@ class NetShardBackend:
         Pongs update liveness directly (no pipeline state touched)."""
         if isinstance(msg, Pong):
             self._last_seen[msg.shard] = time.monotonic()
+            return
+        if isinstance(msg, ECSubWriteBatchReply):
+            # demux the batch into its items' pending entries: each
+            # staged sub-write registered under its OWN tid, so the
+            # ack path below it is indistinguishable from a solo
+            # ECSubWriteReply (parked items simply stay registered)
+            for tid, committed in msg.results:
+                with self._lock:
+                    entry = self._waiting.pop((tid, msg.shard), None)
+                if entry is not None:
+                    self._inbox.put(
+                        lambda e=entry, t=tid, c=committed: e.on_reply(
+                            ECSubWriteReply(t, msg.shard, c)
+                        )
+                    )
             return
         if not isinstance(
             msg,
@@ -281,6 +326,7 @@ class NetShardBackend:
                 if pred():
                     return
             self._expire()
+            self._flush_staged()
             try:
                 thunk = self._inbox.get(timeout=0.05)
             except queue.Empty:
@@ -503,6 +549,67 @@ class NetShardBackend:
     #: fence (standalone pipeline tests leave it None: no fencing)
     interval_fn = None
 
+    # -- sub-write batching scope --------------------------------------
+    def subwrite_batching(self):
+        """Scope within which sub-writes stage per peer instead of
+        going out one frame each; nesting-safe, flushes on exit."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            with self._lock:
+                self._stage_depth += 1
+            try:
+                yield
+            finally:
+                with self._lock:
+                    self._stage_depth -= 1
+                self._flush_staged()
+
+        return scope()
+
+    def _flush_staged(self) -> None:
+        """Ship every staged sub-write: one ECSubWriteBatch per peer
+        with >= 2 items, plain ECSubWrite for singletons (the wire
+        stays byte-identical when nothing actually coalesced)."""
+        with self._lock:
+            if not self._staged:
+                return
+            staged, self._staged = self._staged, {}
+        for shard, items in staged.items():
+            if len(items) == 1:
+                tid, epoch, from_osd, txn = items[0]
+                self._send(
+                    shard,
+                    ECSubWrite(
+                        tid, shard, txn, epoch=epoch, from_osd=from_osd
+                    ),
+                    tid,
+                )
+                continue
+            batch_tid = next(self._tids)
+            msg = ECSubWriteBatch(
+                batch_tid, shard,
+                [(tid, shard, epoch, from_osd, txn)
+                 for tid, epoch, from_osd, txn in items],
+            )
+            try:
+                self._conn(shard).send(msg)
+                if self.on_subwrite_batch is not None:
+                    self.on_subwrite_batch(len(items))
+            except (ConnectionError, OSError, KeyError):
+                # the whole frame is lost: drop every item's pending
+                # entry and mark the peer down, exactly like a failed
+                # solo send (writes park; recovery's problem)
+                with self._lock:
+                    for tid, *_rest in items:
+                        self._waiting.pop((tid, shard), None)
+                if shard not in self.down_shards:
+                    self._log.info(
+                        "shard", shard, "marked down (send failed)"
+                    )
+                self.down_shards.add(shard)
+
     def submit_shard_txn(
         self, shard: int, txn: Transaction, ack: Callable[[], None]
     ) -> None:
@@ -514,10 +621,16 @@ class NetShardBackend:
             # else parked: ack never fires, recovery's problem
 
         self._register(tid, shard, "", on_reply, is_read=False)
-        t_id, t_span = tracer.current()
         epoch, from_osd = (
             self.interval_fn() if self.interval_fn else (0, -1)
         )
+        with self._lock:
+            if self._stage_depth > 0:
+                self._staged.setdefault(shard, []).append(
+                    (tid, epoch, from_osd, txn)
+                )
+                return
+        t_id, t_span = tracer.current()
         self._send(
             shard,
             ECSubWrite(

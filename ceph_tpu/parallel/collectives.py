@@ -111,12 +111,13 @@ def ring_parity(
         out, _ = jax.lax.fori_loop(0, sp - 1, ag_step, (out, mine))
         return out
 
-    fn = jax.shard_map(
+    from .mesh import shard_map_compat
+
+    fn = shard_map_compat(
         local,
-        mesh=mesh,
+        mesh,
         in_specs=(P(None, "sp"), P("dp", "sp", None)),
         out_specs=P("dp", None, None),
-        check_vma=False,
     )
     return fn(bitmatrix, data)
 
@@ -241,12 +242,13 @@ def sharded_crc32c(
         carried = local_bits @ a_sfx.T  # [B, 32] suffix-shifted
         return jax.lax.psum(carried, axes)  # one 32-int all-reduce
 
-    fn = jax.shard_map(
+    from .mesh import shard_map_compat
+
+    fn = shard_map_compat(
         local,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), P(), P(), P(None, axes)),
         out_specs=P(),
-        check_vma=False,
     )
     acc = fn(k_fb, a_fb, suffix, data)
     a_true = jnp.asarray(

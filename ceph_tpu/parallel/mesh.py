@@ -30,6 +30,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ceph_tpu.ops.bitplane import pack_bits, unpack_bits
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the jax API window this repo spans:
+    new jax exports it top-level (replication check kwarg
+    ``check_vma``), 0.4.x keeps it in ``jax.experimental.shard_map``
+    (kwarg ``check_rep``). One seam so every collective call site
+    works on both — without it the whole mesh/DCN tier dies with
+    AttributeError on 0.4.x."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def make_ec_mesh(n_devices: int | None = None, k: int = 8) -> Mesh:
     """Mesh over (dp, sp): sp divides both n_devices and k so the shard
     axis splits evenly; prefer using both axes when possible."""
@@ -81,12 +101,11 @@ def sharded_encode(
         return pack_bits((acc & 1).astype(jnp.uint8))
 
     # bitmatrix columns follow the shard axis: [m*8, k*8] -> sp-sharded.
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local,
-        mesh=mesh,
+        mesh,
         in_specs=(P(None, "sp"), P("dp", "sp", None)),
         out_specs=P("dp", None, None),
-        check_vma=False,
     )
     return fn(bitmatrix, data)
 

@@ -25,12 +25,32 @@ Shape of the machinery:
 - ``encode_sync`` is the synchronous facade for pipeline callers:
   submit + wait, with concurrency across threads supplying the batch.
 
+The round-10 serving tier adds three seams:
+
+- ``coalescing_scope()`` — a thread-local scope the OSD daemon's
+  coalesced tick batch enters around each PG group's execution:
+  inside it, ``ShardExtentMap`` routes encodes through the ring even
+  when ``ec_streaming_dispatch`` is off, so concurrent groups of one
+  tick share batched device dispatches;
+- fused encode+csum ops stage through the SAME ring (``submit`` with
+  ``csum_block``): a fused group stacks every member's chunks into
+  one ``encode_chunks_with_csums`` dispatch — the whole coalesced
+  tick pays one HBM pass for data, parity AND block csums;
+- per-op error isolation: a failed MULTI-op batch no longer fails
+  every member — each op retries SOLO through the codec, and only
+  the op that actually faults surfaces its error (``solo_retries`` /
+  ``batch_faults`` counters). One poisoned op cannot sink its
+  batch-mates.
+
 Counters (``perf dump`` section ``ec_stream``): ops, batches,
-batched_ops (ops that shared a dispatch), plus a max-batch gauge.
+batched_ops (ops that shared a dispatch), plus a max-batch gauge,
+batch_faults (multi-op dispatches that failed and split), and
+solo_retries (ops that recovered via solo fallback).
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import struct
 import threading
@@ -40,8 +60,9 @@ from collections.abc import Callable
 
 import numpy as np
 
-#: slot header: op id, k, chunk length
-_HDR = struct.Struct("<QHI")
+#: slot header: op id, k, chunk count, chunk size, csum block
+#: (csum block 0 = plain encode; then the payload is [k, n*cs] flat)
+_HDR = struct.Struct("<QHHII")
 
 
 @functools.lru_cache(maxsize=1)
@@ -58,7 +79,43 @@ def _stream_counters():
         "batched_ops", "ops that shared a dispatch with at least one other"
     )
     b.add_u64_gauge("max_batch", "largest batch assembled (high-water)")
+    b.add_u64_counter(
+        "batch_faults", "multi-op dispatches that failed and split"
+    )
+    b.add_u64_counter(
+        "solo_retries", "ops recovered via solo fallback after a "
+        "batch fault"
+    )
     return b.create_perf_counters()
+
+
+# ------------------------------------------------------- coalescing scope
+_coal_tls = threading.local()
+
+
+@contextlib.contextmanager
+def coalescing_scope():
+    """Thread-local scope marking this thread's encodes as part of a
+    coalesced tick batch (the OSD daemon enters it around each PG
+    group of a wave). Inside it, the shard-map encode routes through
+    the streaming ring regardless of ``ec_streaming_dispatch`` —
+    concurrent group threads of one tick land their ops in the same
+    ring window and share batched device dispatches. Nesting-safe."""
+    _coal_tls.depth = getattr(_coal_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _coal_tls.depth -= 1
+
+
+def coalescing_active() -> bool:
+    """True on a thread currently inside ``coalescing_scope`` (with
+    the native ring present to stage into)."""
+    if getattr(_coal_tls, "depth", 0) <= 0:
+        return False
+    from ceph_tpu import native
+
+    return native.available()
 
 
 class StreamingDispatcher:
@@ -101,10 +158,20 @@ class StreamingDispatcher:
 
     # -- producer side --------------------------------------------------
     def submit(
-        self, data: np.ndarray, callback: Callable[[np.ndarray], None]
+        self,
+        data: np.ndarray,
+        callback: Callable[[np.ndarray], None],
+        csum_block: int = 0,
+        n_chunks: int = 1,
     ) -> int:
         """Queue one encode of ``data`` [k, L] uint8; ``callback``
-        fires (dispatcher thread) with the parity [m, L]."""
+        fires (dispatcher thread) with the parity [m, L].
+
+        With ``csum_block`` > 0 the op is a FUSED encode+csum: ``L``
+        is ``n_chunks * chunk_size`` (chunk-major per shard) and the
+        callback receives ``(parity [m, L], csums [n_chunks, k+m,
+        cs/cb])`` — or ``(None, None)`` when no fused kernel route
+        serves the geometry (callers keep their per-op fallback)."""
         data = np.ascontiguousarray(data, dtype=np.uint8)
         if data.ndim != 2:
             raise ValueError(f"want [k, L], got {data.shape}")
@@ -113,13 +180,19 @@ class StreamingDispatcher:
             raise ValueError(
                 f"op {k}x{ln} exceeds slot payload {self._slot_payload}"
             )
+        if ln % max(n_chunks, 1):
+            raise ValueError(f"L={ln} not divisible into {n_chunks}")
         with self._lock:
             if self._closed:
                 raise RuntimeError("dispatcher stopped")
             op_id = self._next_id
             self._next_id += 1
             self._pending[op_id] = (callback, k, ln)
-        slot = _HDR.pack(op_id, k, ln) + data.tobytes()
+        slot = (
+            _HDR.pack(op_id, k, n_chunks, ln // max(n_chunks, 1),
+                      csum_block)
+            + data.tobytes()
+        )
         if not self._ring.push(slot, blocking=True):
             # the ring refused the slot (closed by a concurrent
             # stop()): fail loudly — a silent drop would wedge the
@@ -134,14 +207,27 @@ class StreamingDispatcher:
         """Submit + wait; the batch forms from OTHER threads' ops
         arriving inside the window. A codec failure for the batch
         re-raises here (the callback receives the exception)."""
+        out = self._submit_wait(data, 0, 1)
+        return out
+
+    def encode_csum_sync(
+        self, data: np.ndarray, csum_block: int, n_chunks: int
+    ):
+        """Fused submit + wait: ``data`` [k, n_chunks*cs] chunk-major;
+        returns ``(parity [m, L], csums [n_chunks, k+m, cs/cb])`` or
+        ``(None, None)`` when the fused kernel can't serve the
+        geometry."""
+        return self._submit_wait(data, csum_block, n_chunks)
+
+    def _submit_wait(self, data, csum_block, n_chunks):
         ev = threading.Event()
         out: list = []
 
-        def cb(parity) -> None:
-            out.append(parity)
+        def cb(result) -> None:
+            out.append(result)
             ev.set()
 
-        self.submit(data, cb)
+        self.submit(data, cb, csum_block=csum_block, n_chunks=n_chunks)
         ev.wait()
         if isinstance(out[0], BaseException):
             raise out[0]
@@ -189,47 +275,157 @@ class StreamingDispatcher:
 
     def _fire(self, slots: list[bytes]) -> None:
         pc = _stream_counters()
-        groups: dict[tuple[int, int], list[tuple[int, np.ndarray]]] = (
+        #: plain encodes group by flat shape; fused group by chunk
+        #: geometry + csum block (members stack on the chunk axis)
+        plain: dict[tuple[int, int], list[tuple[int, np.ndarray]]] = (
             defaultdict(list)
         )
+        fused: dict[
+            tuple[int, int, int], list[tuple[int, int, np.ndarray]]
+        ] = defaultdict(list)
         for raw in slots:
-            op_id, k, ln = _HDR.unpack_from(raw)
+            op_id, k, nc, cs, cb = _HDR.unpack_from(raw)
+            ln = nc * cs
             payload = np.frombuffer(
                 raw, np.uint8, count=k * ln, offset=_HDR.size
             ).reshape(k, ln)
-            groups[(k, ln)].append((op_id, payload))
-        for (k, ln), members in groups.items():
-            try:
-                stacked = np.stack([p for _, p in members])  # [B, k, L]
-                parity = self.codec.encode_chunks(
-                    {i: stacked[:, i, :] for i in range(k)}
-                )
-                m = len(parity)
-                out = np.stack(
-                    [np.asarray(parity[k + j]) for j in range(m)],
-                    axis=1,
-                )  # [B, m, L]
-                results: list = [out[i] for i in range(len(members))]
-                pc.inc("batches")
-                if len(members) > 1:
-                    pc.inc("batched_ops", len(members))
-                if len(members) > pc.get("max_batch"):
-                    pc.set("max_batch", len(members))
-            except Exception as e:
-                # Deliver the failure to every member — a waiting
-                # encode_sync re-raises it; nobody hangs.
-                results = [e] * len(members)
-            for idx, (op_id, _) in enumerate(members):
-                with self._lock:
-                    cb, _, _ = self._pending.pop(op_id)
-                try:
-                    cb(results[idx])
-                except Exception:
-                    from ceph_tpu.utils.log import get_logger
+            if cb:
+                fused[(k, cs, cb)].append((op_id, nc, payload))
+            else:
+                plain[(k, ln)].append((op_id, payload))
+        for (k, ln), members in plain.items():
+            results = self._fire_plain(pc, k, members)
+            self._deliver(members, results)
+        for (k, cs, cb), fmembers in fused.items():
+            results = self._fire_fused(pc, k, cs, cb, fmembers)
+            self._deliver(fmembers, results)
 
-                    get_logger("ec-stream").error(
-                        "completion callback raised for op", op_id
-                    )
+    def _fire_plain(self, pc, k, members) -> list:
+        try:
+            stacked = np.stack([p for _, p in members])  # [B, k, L]
+            parity = self.codec.encode_chunks(
+                {i: stacked[:, i, :] for i in range(k)}
+            )
+            m = len(parity)
+            out = np.stack(
+                [np.asarray(parity[k + j]) for j in range(m)],
+                axis=1,
+            )  # [B, m, L]
+            results: list = [out[i] for i in range(len(members))]
+            pc.inc("batches")
+            if len(members) > 1:
+                pc.inc("batched_ops", len(members))
+            if len(members) > pc.get("max_batch"):
+                pc.set("max_batch", len(members))
+            return results
+        except Exception as e:
+            return self._solo_fallback(
+                pc, members, e,
+                lambda payload: self._encode_one(k, payload),
+            )
+
+    def _encode_one(self, k: int, payload: np.ndarray) -> np.ndarray:
+        parity = self.codec.encode_chunks(
+            {i: payload[None, i, :] for i in range(k)}
+        )
+        return np.stack(
+            [np.asarray(parity[k + j])[0] for j in range(len(parity))]
+        )
+
+    def _fire_fused(self, pc, k, cs, cb, members) -> list:
+        """One fused encode+csum dispatch for the whole group: every
+        member's chunks stack on the batch axis, so the coalesced
+        tick's data, parity and block csums are one HBM pass. A
+        ``(None, None)`` kernel answer (geometry unservable) is a
+        clean per-member result — callers fall back per-op."""
+
+        def one(payload: np.ndarray):
+            nc = payload.shape[1] // cs
+            chunks = payload.reshape(k, nc, cs).transpose(1, 0, 2)
+            pm, csums = self.codec.encode_chunks_with_csums(
+                {i: chunks[:, i, :] for i in range(k)}, cb
+            )
+            if pm is None:
+                return (None, None)
+            m = len(pm)
+            out = np.stack(
+                [np.asarray(pm[k + j]) for j in range(m)], axis=1
+            )  # [nc, m, cs]
+            return (
+                out.transpose(1, 0, 2).reshape(m, nc * cs),
+                np.asarray(csums),
+            )
+
+        try:
+            counts = [nc for _, nc, _ in members]
+            stacked = np.concatenate(
+                [
+                    p.reshape(k, nc, cs).transpose(1, 0, 2)
+                    for _, nc, p in members
+                ],
+                axis=0,
+            )  # [sum(nc), k, cs]
+            pm, csums = self.codec.encode_chunks_with_csums(
+                {i: stacked[:, i, :] for i in range(k)}, cb
+            )
+            if pm is None:
+                return [(None, None)] * len(members)
+            m = len(pm)
+            out = np.stack(
+                [np.asarray(pm[k + j]) for j in range(m)], axis=1
+            )  # [sum(nc), m, cs]
+            csums = np.asarray(csums)
+            results: list = []
+            pos = 0
+            for nc in counts:
+                sl = out[pos : pos + nc]  # [nc, m, cs]
+                results.append((
+                    sl.transpose(1, 0, 2).reshape(m, nc * cs),
+                    csums[pos : pos + nc],
+                ))
+                pos += nc
+            pc.inc("batches")
+            if len(members) > 1:
+                pc.inc("batched_ops", len(members))
+            if len(members) > pc.get("max_batch"):
+                pc.set("max_batch", len(members))
+            return results
+        except Exception as e:
+            return self._solo_fallback(
+                pc, members, e, lambda payload: one(payload)
+            )
+
+    def _solo_fallback(self, pc, members, batch_err, one) -> list:
+        """Per-op error isolation: a failed MULTI-op dispatch retries
+        each member solo so one poisoned op cannot fail its
+        batch-mates; a solo failure delivers the error to that member
+        alone (a waiting encode_sync re-raises it; nobody hangs)."""
+        if len(members) == 1:
+            return [batch_err]
+        pc.inc("batch_faults")
+        results: list = []
+        for member in members:
+            payload = member[-1]
+            try:
+                results.append(one(payload))
+                pc.inc("solo_retries")
+            except Exception as solo_err:
+                results.append(solo_err)
+        return results
+
+    def _deliver(self, members, results) -> None:
+        for idx, member in enumerate(members):
+            op_id = member[0]
+            with self._lock:
+                cb, _, _ = self._pending.pop(op_id)
+            try:
+                cb(results[idx])
+            except Exception:
+                from ceph_tpu.utils.log import get_logger
+
+                get_logger("ec-stream").error(
+                    "completion callback raised for op", op_id
+                )
 
     # -- lifecycle -------------------------------------------------------
     def stop(self) -> None:

@@ -217,13 +217,24 @@ class ShardExtentMap:
             and lo % cb == 0
             and hasattr(codec, "encode_chunks_with_csums")
         ):
-            parity_map, csums = codec.encode_chunks_with_csums(
-                {i: data[i] for i in range(k)}, cb
-            )
-            if parity_map is not None:
-                parity = np.stack(
-                    [np.asarray(parity_map[k + j]) for j in range(m)]
+            # Coalesced/streaming route first: the fused op stages in
+            # the ring and shares ONE encode+csum dispatch with every
+            # other op of the tick window (the same batching win the
+            # plain encode gets below). (None, None) = the fused
+            # kernel can't serve the geometry; fall through per-op.
+            staged = self._ring_encode_csum(codec, data, cs, cb)
+            if staged is not None:
+                parity2d, csums = staged
+                if parity2d is not None:
+                    parity = parity2d.reshape(m, n_chunks, cs)
+            if csums is None:
+                parity_map, csums = codec.encode_chunks_with_csums(
+                    {i: data[i] for i in range(k)}, cb
                 )
+                if parity_map is not None:
+                    parity = np.stack(
+                        [np.asarray(parity_map[k + j]) for j in range(m)]
+                    )
         if parity is None:
             parity = self._dispatch_encode(codec, data)
         for j in range(m):
@@ -283,24 +294,55 @@ class ShardExtentMap:
                     )
 
     @staticmethod
+    def _ring_routable(codec, nbytes: int) -> bool:
+        """One gate for both ring routes: streaming config on, OR this
+        thread is inside a coalesced OSD tick (dispatcher.
+        coalescing_scope) — concurrent tick groups stage into the same
+        ring window either way. Sub-chunk codecs (CLAY) give chunk
+        geometry meaning beyond byte count, and ops beyond a ring slot
+        can't stage — both keep the per-op path."""
+        from .dispatcher import (
+            coalescing_active,
+            dispatcher_for,
+            streaming_enabled,
+        )
+
+        if codec.get_sub_chunk_count() != 1:
+            return False
+        if not (streaming_enabled() or coalescing_active()):
+            return False
+        return nbytes <= dispatcher_for(codec).max_op_bytes
+
+    @staticmethod
+    def _ring_encode_csum(codec, data, cs: int, cb: int):
+        """Stage one fused encode+csum op in the ring, or None when
+        the ring isn't routable for it. ``data`` is [k, n_chunks, cs];
+        returns ``(parity [m, L] | None, csums | None)``."""
+        from .dispatcher import dispatcher_for
+
+        if not ShardExtentMap._ring_routable(codec, data.nbytes):
+            return None
+        k, n_chunks, _cs = data.shape
+        return dispatcher_for(codec).encode_csum_sync(
+            np.ascontiguousarray(data).reshape(k, n_chunks * cs),
+            cb, n_chunks,
+        )
+
+    @staticmethod
     def _dispatch_encode(codec, data: np.ndarray) -> np.ndarray:
         """[k, L] host -> [m, L] host through the codec's dispatch.
-        With ``ec_streaming_dispatch`` on, the op rides the native
-        staging ring and shares a batched device dispatch with other
-        concurrent ops (pipeline/dispatcher.py)."""
-        from .dispatcher import dispatcher_for, streaming_enabled
+        With ``ec_streaming_dispatch`` on — or inside a coalesced OSD
+        tick — the op rides the native staging ring and shares a
+        batched device dispatch with other concurrent ops
+        (pipeline/dispatcher.py)."""
+        from .dispatcher import dispatcher_for
 
         k = data.shape[0]
         flat = data.reshape(k, -1)
-        # Sub-chunk codecs (CLAY) give chunk geometry meaning beyond
-        # byte count, and ops beyond a ring slot can't stage — both
-        # keep the per-op path.
-        if streaming_enabled() and codec.get_sub_chunk_count() == 1:
-            disp = dispatcher_for(codec)
-            if flat.nbytes <= disp.max_op_bytes:
-                return disp.encode_sync(flat).reshape(
-                    (-1,) + data.shape[1:]
-                )
+        if ShardExtentMap._ring_routable(codec, flat.nbytes):
+            return dispatcher_for(codec).encode_sync(flat).reshape(
+                (-1,) + data.shape[1:]
+            )
         parity = codec.encode_chunks(
             {i: np.asarray(data[i]) for i in range(k)}
         )
