@@ -478,6 +478,151 @@ def _measure_code_families(result: dict) -> None:
             pass  # scorecard entries are best-effort; headline must print
 
 
+def _measure_sched_superopt(result: dict) -> None:
+    """Round-11 phase: the XOR-schedule superoptimizer scorecard.
+
+    Host rows (device-free): per packet family at the bench geometry,
+    the raw ones count, selection-form XOR count, post-CSE op count
+    and saving fraction (``xor_schedule.cse_stats``) — the numbers the
+    tier-1 golden pins assert, recorded next to the measured rates.
+
+    Device rows:
+    - ``sched_unopt_liberation_gbps``: the liberation encode
+      re-measured with ``ec_sched_opt=false`` — the within-run A/B leg
+      against ``liberation_k4m2_gbps`` (code-families phase, optimizer
+      on). Same geometry, same session: the pair isolates the CSE'd
+      multi-level schedule's effect on the dispatch ceiling.
+    - ``lrc_local_repair_gbps``: single-lost-chunk repair on the
+      xor-local-parity LRC profile (k=4 m=2 l=3, 64 KiB chunks),
+      survivor-bytes-in basis — the locality story's on-device rate:
+      3 survivor chunks read instead of k, through the schedule
+      engine's w=1 route (BASELINE `lrc_*_gbps >= 200` row).
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.codecs.registry import registry
+        from ceph_tpu.ops import xor_schedule
+        from ceph_tpu.utils import config
+    except Exception:
+        return
+    fam_profiles = [
+        ("liberation", {"technique": "liberation", "k": "4", "m": "2",
+                        "w": "7"}),
+        ("blaum_roth", {"technique": "blaum_roth", "k": "4", "m": "2",
+                        "w": "6"}),
+        ("liber8tion", {"technique": "liber8tion", "k": "4", "m": "2",
+                        "w": "8"}),
+    ]
+    for fam, profile in fam_profiles:
+        try:
+            codec = registry.factory("jerasure", dict(profile))
+            st = xor_schedule.cse_stats(codec.coding_bitmatrix)
+            result[f"{fam}_sched_raw_xors"] = st["raw_xors"]
+            result[f"{fam}_sched_opt_xors"] = st["opt_xors"]
+            result[f"{fam}_sched_cse_saving"] = st["saving_frac"]
+        except Exception:
+            pass
+
+    def encode_loop_gbps(codec, k, chunk, stripes, seed):
+        sz = stripes * chunk
+        flat = _device_rand((k * sz,), seed)
+        shards = tuple(
+            flat[i * sz : (i + 1) * sz].reshape(stripes, chunk)
+            for i in range(k)
+        )
+
+        @jax.jit
+        def loop(arrs, iters):
+            def body(i, carry):
+                arrs, acc = carry
+                parity = codec.encode_chunks(
+                    {j: arrs[j] for j in range(k)}
+                )
+                outs = [parity[j] for j in sorted(parity)]
+                fold = jax.lax.dynamic_slice(outs[0], (0, 0), (1, 128))
+                scalar = fold[0, 0]
+                for o in outs[1:]:
+                    scalar = scalar ^ o[0, 0]
+                first = jax.lax.dynamic_update_slice(
+                    arrs[0], fold ^ jnp.uint8(i + 1), (0, 0)
+                )
+                return (first,) + arrs[1:], acc ^ scalar
+
+            _, acc = jax.lax.fori_loop(
+                0, iters, body, (arrs, jnp.uint8(0))
+            )
+            return acc
+
+        per, iqr = _loop_stats(loop, shards, reps=3)
+        g = stripes * k * chunk / per / 1e9
+        return g, g - stripes * k * chunk / (per + iqr) / 1e9
+
+    # A/B leg: liberation encode on the PINNED selection-form
+    # schedule (the escape hatch) — trace under the override so the
+    # route decision compiles with the optimizer off
+    try:
+        with config.override(ec_sched_opt=False):
+            codec = registry.factory(
+                "jerasure", dict(fam_profiles[0][1])
+            )
+            g, iqr = encode_loop_gbps(codec, 4, 7 * 16384, 160, 21)
+        result["sched_unopt_liberation_gbps"] = round(g, 2)
+        result["sched_unopt_liberation_iqr"] = round(iqr, 2)
+    except Exception:
+        pass
+
+    # LRC local repair: one lost data chunk, minimum survivors only
+    # (3 chunks of the local group), xor local parity -> schedule
+    # route on TPU
+    try:
+        codec = registry.factory(
+            "lrc",
+            {"k": "4", "m": "2", "l": "3", "local_parity": "xor"},
+        )
+        chunk, stripes, lost = 65536, 256, 0
+        plan = codec.minimum_to_decode(
+            {lost}, set(range(codec.k + codec.m)) - {lost}
+        )
+        keys = sorted(plan)
+        sz = stripes * chunk
+        flat = _device_rand((len(keys) * sz,), 23)
+        arrs0 = tuple(
+            flat[i * sz : (i + 1) * sz].reshape(stripes, chunk)
+            for i in range(len(keys))
+        )
+
+        @jax.jit
+        def rloop(arrs, iters):
+            def body(i, carry):
+                arrs, acc = carry
+                out = codec.decode_chunks(
+                    {lost}, dict(zip(keys, arrs))
+                )[lost]
+                fold = jax.lax.dynamic_slice(out, (0, 0), (1, 128))
+                first = jax.lax.dynamic_update_slice(
+                    arrs[0], fold ^ jnp.uint8(i + 1), (0, 0)
+                )
+                return (first,) + arrs[1:], acc ^ fold[0, 0]
+
+            _, acc = jax.lax.fori_loop(
+                0, iters, body, (arrs, jnp.uint8(0))
+            )
+            return acc
+
+        nbytes = len(keys) * sz  # survivor bytes read per repair
+        per, iqr = _loop_stats(rloop, arrs0, reps=3)
+        g = nbytes / per / 1e9
+        result["lrc_local_repair_gbps"] = round(g, 2)
+        result["lrc_local_repair_iqr"] = round(
+            g - nbytes / (per + iqr) / 1e9, 2
+        )
+        result["lrc_local_repair_survivors"] = len(keys)
+    except Exception:
+        pass
+
+
 def _measure_clay_repair(result: dict) -> None:
     """BASELINE config 4 + the general-d envelope: CLAY single-chunk
     repair, helper bytes read per second, device loop with feedback —
@@ -1004,6 +1149,24 @@ def main() -> None:
         _measure_baseline_configs(result)
     with _phase("code_families"):
         _measure_code_families(result)
+    with _phase("sched_superopt"):
+        _measure_sched_superopt(result)
+        # the dispatch-path ceiling: best packet-family rate through
+        # the (optimized) schedule engine this run — the > 537 GB/s
+        # round-11 target row
+        rates = [
+            result.get(k)
+            for k in (
+                "liberation_k4m2_gbps",
+                "blaum_roth_k4m2_gbps",
+                "liber8tion_k4m2_gbps",
+            )
+        ]
+        rates = [r for r in rates if isinstance(r, (int, float))]
+        if rates:
+            result["sched_dispatch_ceiling_gbps"] = round(
+                max(rates), 2
+            )
     with _phase("clay_repair"):
         _measure_clay_repair(result)
     degraded = rtt is None or rtt > RTT_HEALTHY_MS

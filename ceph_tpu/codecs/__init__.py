@@ -11,6 +11,8 @@ default behavior (``base``), and the code families:
 - ``lrc``: locally repairable layered codes
 - ``shec``: shingled erasure code
 - ``clay``: coupled-layer MSR regenerating code
+- ``xor``: single-parity XOR (Azure-LRC-style local parity; the
+  schedule-engine fast path for LRC ``local_parity=xor`` layers)
 """
 
 from .interface import (  # noqa: F401
@@ -32,3 +34,4 @@ from . import isa as _isa  # noqa: E402,F401
 from . import lrc as _lrc  # noqa: E402,F401
 from . import shec as _shec  # noqa: E402,F401
 from . import clay as _clay  # noqa: E402,F401
+from . import xor_codec as _xor  # noqa: E402,F401

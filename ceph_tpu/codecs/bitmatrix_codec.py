@@ -262,7 +262,6 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         self.coding_bitmatrix: np.ndarray | None = None  # [m*w, k*w]
         self._tables = DecodeTableCache()       # device matrices
         self._host_tables = DecodeTableCache()  # packet 0/1 matrices
-        self._sched_tables = DecodeTableCache()  # XOR schedules
 
     def _set_bitmatrix(self, coding: np.ndarray) -> None:
         assert coding.shape == (self.m * self.w, self.k * self.w)
@@ -314,29 +313,36 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         from ceph_tpu.utils import config
 
         packets = self._to_packets(stacked)
-        if (
-            not self._mesh_routable(packets)
-            and not self._dcn_routable(packets)
-            and self._host_sized(packets)
-        ):
+        multi = self._mesh_routable(packets) or self._dcn_routable(
+            packets
+        )
+        if not multi and self._host_sized(packets):
             from ceph_tpu.gf import gf_apply_bytes_host
 
             _dispatch_counters().inc(f"host_{op}")
             out = gf_apply_bytes_host(mat01, np.asarray(packets))
-        elif (
-            config.get("ec_use_sched")
-            and not self._mesh_routable(packets)
-            and not self._dcn_routable(packets)
-            and xor_schedule.supported((1,) + packets.shape[-2:])
-            and (rows := self._schedule_rows(mat01)) is not None
-        ):
+            return self._to_chunks(out)
+        out = None
+        if config.get("ec_use_sched") and not multi:
             # schedule-native route: sparse packet matrices ARE XOR
-            # networks (jerasure_schedule_encode's insight); traffic
-            # tracks matrix density, not dimension. Dense matrices
-            # (inverted decode tables) fall through to the MXU engine.
-            _dispatch_counters().inc(f"sched_{op}")
-            out = xor_schedule.xor_schedule_apply(rows, packets)
-        else:
+            # networks (jerasure_schedule_encode's insight), and the
+            # round-11 optimizer CSE-compresses denser shapes —
+            # inverted decode tables, parity-delta columns — under
+            # the op-count gate. Matrices still over the gate, or
+            # shapes no schedule kernel can tile, fall through to the
+            # MXU engine — counted here, the terminal schedule probe
+            # (the shards-form probe upstream never counts).
+            rows = self._schedule_rows(mat01)
+            if rows is None:
+                _dispatch_counters().inc("sched_rejected_density")
+            elif not xor_schedule.supported(
+                (1,) + packets.shape[-2:]
+            ):
+                _dispatch_counters().inc("sched_rejected_shape")
+            else:
+                _dispatch_counters().inc(f"sched_{op}")
+                out = xor_schedule.xor_schedule_apply(rows, packets)
+        if out is None:
             if tables:
                 bm_np, bm_dev = tables
             else:
@@ -365,48 +371,27 @@ class BitMatrixCodec(BitplaneDispatchMixin, ErasureCodeBase):
         arrays out — no [.., n, chunk] stack, no packetize reshape
         (both are real relayout copies on TPU; see
         ops/xor_schedule.py). Returns the list of output shards, or
-        None when any precondition fails (dense matrix, off-TPU,
+        None when any precondition fails (over-gate matrix, off-TPU,
         VMEM-oversized chunks, mesh/DCN installed, host-sized numpy
-        input — each of those keeps its existing route)."""
-        from ceph_tpu.utils import config
-
-        if not config.get("ec_use_sched") or not xor_schedule.on_tpu():
-            return None
-        rows = self._schedule_rows(mat01)
-        if rows is None:
-            return None
-        shape = shards[0].shape
-        if any(s.shape != shape for s in shards[1:]):
-            return None
-        if not xor_schedule.shards_supported(
-            len(shards), len(rows) // self.w, self.w, shape
-        ):
-            return None
-        if self._host_sized(*shards):
-            return None
-        # mesh/DCN routing operates on the stacked form and outranks
-        # single-chip paths; probe with the would-be stacked shape
-        probe = shape[:-1] + (len(shards) * self.w, shape[-1] // self.w)
-        if self._mesh_routable_shape(probe) or self._dcn_routable_shape(
-            probe, all(isinstance(s, np.ndarray) for s in shards)
-        ):
-            return None
-        _dispatch_counters().inc(f"sched_{op}")
-        return xor_schedule.xor_schedule_apply_shards(
-            rows, shards, self.w
+        input — each of those keeps its existing route). Rejections
+        are NOT counted here: the packetized probe in
+        _apply_packet_matrix is the terminal one."""
+        return self._sched_shards_route(
+            mat01, shards, self.w, op, count_reject=False
         )
 
     def _schedule_rows(self, mat01: np.ndarray):
-        """Cached XOR schedule for a 0/1 packet matrix, or None when
-        the matrix is too dense for the schedule route to win."""
-        key = ("sched", mat01.tobytes(), mat01.shape)
+        """The route's schedule for a 0/1 packet matrix — the CSE'd
+        multi-level program under ``ec_sched_opt`` (gated on post-CSE
+        op count), the pinned selection form otherwise (gated on raw
+        density) — or None when the matrix stays over its gate.
+        Cached process-wide in ops.xor_schedule (schedules depend
+        only on the matrix bytes)."""
+        from ceph_tpu.utils import config
 
-        def build():
-            rows = xor_schedule.schedule_rows(mat01)
-            ok = xor_schedule.profitable(rows, mat01.shape[1])
-            return rows if ok else None
-
-        return self._sched_tables.get(key, build)
+        return xor_schedule.routable_schedule(
+            mat01, config.get("ec_sched_opt")
+        )
 
     def encode_chunks(
         self, data: dict[int, jax.Array]

@@ -22,6 +22,20 @@ shards-form MXU kernel: local repair of one lost chunk is one small
 [1*8, l*8] matmul over the local group's survivors, with no
 block-diagonal padding tax and no [.., C, N] stack relayout
 (ops/pallas_encode.py round-6 packing).
+
+Round 11 — the schedule route for local repair: the kml form accepts
+``local_parity=xor`` (default ``rs`` keeps the corpus-pinned
+reed_sol_van layout), which generates the local layers on the ``xor``
+plugin — Azure-LRC-style XOR local parities. Their encode, repair,
+and parity-delta rows are then 0/1-valued, so the inner dispatch
+rides the schedule-native XOR engine (matrix_codec._try_sched_bytes,
+w=1: one multi-operand VPU kernel over the local group, ``sched_*``
+counters) instead of streaming a bit-plane matrix through the MXU —
+the fixed-engine rate the ``lrc_local_repair_gbps`` bench row
+measures. GF-coefficient local parities (the ``rs`` default)
+mathematically cannot ride a byte-XOR engine — their repair rows mix
+bits within bytes — which is why this is a layout option, not a
+dispatch flag.
 """
 
 from __future__ import annotations
@@ -58,7 +72,8 @@ class Layer:
         prof.setdefault("k", str(len(self.data)))
         prof.setdefault("m", str(len(self.coding)))
         prof.setdefault("plugin", "jerasure")
-        prof.setdefault("technique", "reed_sol_van")
+        if prof["plugin"] == "jerasure":
+            prof.setdefault("technique", "reed_sol_van")
         plugin = prof.pop("plugin")
         self.codec = registry.factory(plugin, prof)
 
@@ -138,11 +153,25 @@ class LrcCodec(BitplaneDispatchMixin, ErasureCodeBase):
     # -- profile parsing ----------------------------------------------
     def _parse_kml(self, prof: ErasureCodeProfile) -> None:
         """Expand k/m/l into mapping + layers (parse_kml,
-        ErasureCodeLrc.cc:291-360)."""
+        ErasureCodeLrc.cc:291-360). ``local_parity`` picks the
+        generated local layers' code: ``rs`` (default; reed_sol_van,
+        the corpus-pinned layout) or ``xor`` (the xor plugin —
+        Azure-LRC-style XOR local parities whose repair rides the
+        schedule engine). Global layers are always RS."""
+        local_parity = prof.pop("local_parity", "rs")
+        if local_parity not in ("rs", "xor"):
+            raise ValueError(
+                f"local_parity={local_parity!r} must be 'rs' or 'xor'"
+            )
         k = to_int("k", prof, -1)
         m = to_int("m", prof, -1)
         l = to_int("l", prof, -1)
         if k == -1 and m == -1 and l == -1:
+            if local_parity != "rs":
+                raise ValueError(
+                    "local_parity applies to the k/m/l form only "
+                    "(explicit layers name their own plugin)"
+                )
             return
         if -1 in (k, m, l):
             raise ValueError("All of k, m, l must be set or none of them")
@@ -167,6 +196,7 @@ class LrcCodec(BitplaneDispatchMixin, ErasureCodeBase):
         )
         # One local layer per group: group data + group coding as local
         # data, the trailing slot as the local parity.
+        local_prof = "plugin=xor" if local_parity == "xor" else ""
         for g in range(groups):
             row = (
                 "_" * (g * (kg + mg + 1))
@@ -174,7 +204,7 @@ class LrcCodec(BitplaneDispatchMixin, ErasureCodeBase):
                 + "c"
                 + "_" * ((groups - g - 1) * (kg + mg + 1))
             )
-            layer_list.append([row, ""])
+            layer_list.append([row, local_prof])
         prof["layers"] = json.dumps(layer_list)
 
     def _layers_parse(self, description: str) -> list[Layer]:

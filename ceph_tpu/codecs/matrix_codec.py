@@ -24,6 +24,7 @@ from ceph_tpu.gf import (
     decode_matrix,
     gf_matrix_to_bitmatrix,
 )
+from ceph_tpu.ops import xor_schedule
 from ceph_tpu.ops.bitplane import gf_encode_bitplane, xor_bytes
 
 from .base import ErasureCodeBase
@@ -68,6 +69,19 @@ def _dispatch_counters():
         "fused encode+csum requests the kernel could not serve "
         "(untileable shape / non-TPU without interpret) — parity "
         "encoded normally, csums fell back to the host tier",
+    )
+    b.add_u64_counter(
+        "sched_rejected_density",
+        "sched-eligible dispatches that fell back to the MXU engine "
+        "because even the post-CSE schedule stayed over the op-count "
+        "gate (dense matrix); counted once per dispatch at the "
+        "terminal schedule probe",
+    )
+    b.add_u64_counter(
+        "sched_rejected_shape",
+        "sched-eligible dispatches that fell back because no "
+        "schedule kernel form could tile the shape (packet axis not "
+        "lane-tileable / VMEM-oversized shard blocks)",
     )
     b.add_u64_counter(
         "pallas_fallback",
@@ -300,6 +314,89 @@ class BitplaneDispatchMixin:
         _dispatch_counters().inc(f"einsum_{op}")
         return _apply_bitmatrix(bmat_dev, stacked)
 
+    def _sched_shards_route(
+        self,
+        mat01: np.ndarray,
+        shards: list,
+        w: int,
+        op: str,
+        count_reject: bool = False,
+    ):
+        """Shared schedule-engine shards dispatch for a 0/1 packet
+        matrix (w packets per chunk; w=1 means whole-chunk byte
+        rows). Builds the route's schedule — CSE-optimized multi-
+        level program under ``ec_sched_opt`` (default), the pinned
+        selection form otherwise — gates it on post-CSE op count /
+        raw density respectively, and serves the op through the
+        multi-operand schedule kernel: shard arrays in, shard arrays
+        out, no stack relayout. Returns the output shard list, or
+        None when any precondition fails (each of those keeps its
+        existing route).
+
+        ``count_reject`` marks the TERMINAL schedule probe for an op:
+        only that site increments ``sched_rejected_density`` /
+        ``sched_rejected_shape``, so one logical dispatch counts one
+        rejection even when several kernel forms probe it. Rejections
+        are only counted for ops the schedule engine would otherwise
+        have owned — host-sized and mesh/DCN-routed shapes bail first
+        (those routes outrank the schedule the same way they outrank
+        Pallas)."""
+        from ceph_tpu.utils import config
+
+        if not config.get("ec_use_sched") or not xor_schedule.on_tpu():
+            return None
+        shape = shards[0].shape
+        if any(s.shape != shape for s in shards[1:]):
+            return None
+        if self._host_sized(*shards):
+            return None
+        # mesh/DCN routing operates on the stacked form and outranks
+        # single-chip paths; probe with the would-be stacked shape
+        probe = shape[:-1] + (len(shards) * w, shape[-1] // w)
+        if self._mesh_routable_shape(probe) or self._dcn_routable_shape(
+            probe, all(isinstance(s, np.ndarray) for s in shards)
+        ):
+            return None
+        sched = xor_schedule.routable_schedule(
+            mat01, config.get("ec_sched_opt")
+        )
+        if sched is None:
+            if count_reject:
+                _dispatch_counters().inc("sched_rejected_density")
+            return None
+        n_slots = 0
+        if isinstance(sched, xor_schedule.Schedule):
+            n_slots = xor_schedule._linearize(sched)[1]
+        if not xor_schedule.shards_supported(
+            len(shards), xor_schedule._n_rows(sched) // w, w, shape,
+            n_slots,
+        ):
+            if count_reject:
+                _dispatch_counters().inc("sched_rejected_shape")
+            return None
+        _dispatch_counters().inc(f"sched_{op}")
+        return xor_schedule.xor_schedule_apply_shards(sched, shards, w)
+
+    def _try_sched_bytes(
+        self, mat: np.ndarray, shards: list, op: str
+    ):
+        """w=1 schedule route for GF(2^8) BYTE matrices whose entries
+        are all 0/1: over the subfield {0,1} each output chunk is a
+        pure XOR of input chunks, so the packet engine applies with
+        packet == chunk. This is how LRC xor-local-parity repair (a
+        single all-ones decode row) and the xor plugin's parity ride
+        the schedule engine. Generic GF coefficient rows never
+        qualify and bail on the cheap max() probe with no counter —
+        they are not schedule-eligible, not rejected. This is the
+        byte codecs' terminal schedule probe, so rejections count."""
+        mat = np.asarray(mat)
+        if mat.size == 0 or int(mat.max()) > 1:
+            return None
+        return self._sched_shards_route(
+            np.ascontiguousarray(mat, dtype=np.uint8), shards, 1, op,
+            count_reject=True,
+        )
+
     def _shards_host_route(self, shards: list, host_staged: bool) -> bool:
         """One gate for every per-shard dispatch site: small host-
         staged inputs take the host GF tables UNLESS a mesh/DCN wants
@@ -461,8 +558,10 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
 
     def _encode_shards(self, shards: list, xp) -> list:
         """Dispatch the parity matmul: host GF tables for small numpy
-        inputs, the shards-form Pallas MXU kernel on TPU for
-        per-shard device arrays, the stacked routes otherwise."""
+        inputs, the schedule engine for 0/1 parity rows (the xor
+        plugin / LRC xor-local layers), the shards-form Pallas MXU
+        kernel on TPU for per-shard device arrays, the stacked routes
+        otherwise."""
         if self._shards_host_route(shards, xp is np):
             from ceph_tpu.gf import gf_apply_bytes_host
 
@@ -471,6 +570,11 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
                 self.generator[self.k :, :], np.stack(shards, axis=-2)
             )
             return [out[..., j, :] for j in range(self.m)]
+        outs = self._try_sched_bytes(
+            self.generator[self.k :, :], shards, "encode"
+        )
+        if outs is not None:
+            return outs
         return self._dispatch_bitmatrix_shards(
             self._encode_bmat_np, self._encode_bmat, shards, "encode"
         )
@@ -501,17 +605,27 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
             out = gf_apply_bytes_host(mat, np.stack(shards, axis=-2))
             outs = [out[..., j, :] for j in range(len(want))]
         else:
-            bmat_np = self._tables.get(
-                key, lambda: self._build_decode_bmat(present, want)
+            # 0/1 decode rows (XOR-parity local groups: the common
+            # LRC local repair) ride the schedule engine as w=1
+            # whole-chunk packets — _build_decode_bytes is the same
+            # host matrix the host route caches, so the probe shares
+            # its table
+            mat = self._host_tables.get(
+                key, lambda: self._build_decode_bytes(present, want)
             )
-            traced = any(
-                isinstance(v, jax.core.Tracer) for v in shards
-            )
-            outs = self._dispatch_bitmatrix_shards(
-                bmat_np,
-                dev_bmat(self._tables, key, bmat_np, traced),
-                shards, "decode",
-            )
+            outs = self._try_sched_bytes(mat, shards, "decode")
+            if outs is None:
+                bmat_np = self._tables.get(
+                    key, lambda: self._build_decode_bmat(present, want)
+                )
+                traced = any(
+                    isinstance(v, jax.core.Tracer) for v in shards
+                )
+                outs = self._dispatch_bitmatrix_shards(
+                    bmat_np,
+                    dev_bmat(self._tables, key, bmat_np, traced),
+                    shards, "decode",
+                )
         result = {w: chunks[w] for w in want_to_read if w in chunks}
         for idx, w in enumerate(want):
             result[w] = outs[idx]
@@ -580,16 +694,27 @@ class MatrixErasureCodec(BitplaneDispatchMixin, ErasureCodeBase):
             }
 
         key = ("delta", tuple(cols))
-        bmat_np = self._tables.get(
-            key,
-            lambda: gf_matrix_to_bitmatrix(self.generator[self.k :, cols]),
+        # 0/1 delta columns (xor plugin / LRC xor-local layers): the
+        # parity-delta contribution is a pure XOR program — the
+        # schedule engine's w=1 form
+        contribs = self._try_sched_bytes(
+            self.generator[self.k :, cols], shards, "delta"
         )
-        traced = any(isinstance(v, jax.core.Tracer) for v in shards)
-        contribs = self._dispatch_bitmatrix_shards(
-            bmat_np,
-            dev_bmat(self._tables, key, bmat_np, traced),
-            shards, "delta",
-        )
+        if contribs is None:
+            bmat_np = self._tables.get(
+                key,
+                lambda: gf_matrix_to_bitmatrix(
+                    self.generator[self.k :, cols]
+                ),
+            )
+            traced = any(
+                isinstance(v, jax.core.Tracer) for v in shards
+            )
+            contribs = self._dispatch_bitmatrix_shards(
+                bmat_np,
+                dev_bmat(self._tables, key, bmat_np, traced),
+                shards, "delta",
+            )
         return {
             pid: xor_bytes(p, contribs[pid - self.k])
             for pid, p in parity.items()

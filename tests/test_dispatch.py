@@ -152,9 +152,10 @@ class TestBitMatrixFamilyOnEngine:
         parity = lib_codec.encode_chunks(data)
         chunks = dict(data) | parity
         # two DATA erasures: the decode matrix needs the inverted
-        # X-block compositions — dense, stays on the generic engine
-        # (a 1-data+1-parity pattern substitutes through the sparse P
-        # row and legitimately rides the schedule instead)
+        # X-block compositions — dense in RAW form (~50% ones), but
+        # round 11's CSE compresses it under the op-count gate, so it
+        # now rides the schedule route too (the superopt headline;
+        # tests/test_sched_superopt.py pins the gate math)
         del chunks[0], chunks[1]
         out = lib_codec.decode_chunks({0, 1}, chunks)
         deltas = {
@@ -166,11 +167,26 @@ class TestBitMatrixFamilyOnEngine:
         # encode: the sparse coding matrix rides the XOR-schedule route
         assert d.get("sched_encode", 0) >= 1
         assert d.get("einsum_encode", 0) == 0
-        assert d.get("einsum_decode", 0) >= 1
-        assert d.get("sched_decode", 0) == 0
+        assert d.get("sched_decode", 0) >= 1
+        assert d.get("einsum_decode", 0) == 0
         assert d.get("sched_delta", 0) >= 1
         np.testing.assert_array_equal(
             np.asarray(out[0]), np.asarray(data[0])
+        )
+        # the un-optimized selection form (escape hatch) keeps the
+        # pre-round-11 routing: raw density rejects the inverted
+        # matrix and the generic engine serves it, rejection counted
+        from ceph_tpu.utils import config
+
+        before = _snap()
+        with config.override(ec_sched_opt=False):
+            out2 = lib_codec.decode_chunks({0, 1}, dict(chunks))
+        d = _delta(before, _snap())
+        assert d.get("einsum_decode", 0) >= 1
+        assert d.get("sched_decode", 0) == 0
+        assert d.get("sched_rejected_density", 0) >= 1
+        np.testing.assert_array_equal(
+            np.asarray(out2[0]), np.asarray(out[0])
         )
 
     def test_sched_route_matches_engine(self, rng, lib_codec):
