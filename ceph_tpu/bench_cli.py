@@ -123,7 +123,12 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     lg.add_argument("--revive-at", type=int, default=0,
                     help="revive it at this op count (0 = at run end)")
     lg.add_argument("--fault-osd", type=int, default=-1,
-                    help="kill victim (-1 = the least-primary OSD)")
+                    help="kill victim osd id (-1 = use --victim)")
+    lg.add_argument("--victim", default="most_primary",
+                    choices=["least_primary", "most_primary"],
+                    help="named victim picker when --fault-osd is -1 "
+                         "(default most_primary: maximum simultaneous "
+                         "primary takeovers — the peering soak path)")
     lg.add_argument("--device-clock", action="store_true",
                     help="report small-op p99 from the device clock "
                          "(tunnel-RTT independent)")
@@ -325,7 +330,7 @@ def _run_loadgen(args) -> tuple[float, float]:
         osds, k, m, chunk = 5, 2, 1, 1024
         fault_at = spec.total_ops // 3
         revive_at = (2 * spec.total_ops) // 3
-        args.fault_osd = -1  # least-primary victim, resolved below
+        args.fault_osd = -1  # named victim, resolved below
     else:
         kw: dict = {}
         if args.mix is not None:
@@ -365,16 +370,17 @@ def _run_loadgen(args) -> tuple[float, float]:
     )
     schedule = None
     if fault_at:
-        victim = args.fault_osd
-        if victim == -1:
-            victim = cluster.least_primary_osd()
+        # -1 = a NAMED picker resolved at fire time (the default
+        # most_primary targets the takeover path the FSM soaks)
+        victim = (
+            args.fault_osd if args.fault_osd != -1 else args.victim
+        )
         events = [
             FaultEvent(at_op=fault_at, action="kill", osd=victim)
         ]
         if revive_at:
             events.append(
-                FaultEvent(at_op=revive_at, action="revive",
-                           osd=victim)
+                FaultEvent(at_op=revive_at, action="revive")
             )
         schedule = FaultSchedule(events)
     from ceph_tpu.utils import config as _config

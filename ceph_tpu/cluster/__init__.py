@@ -12,6 +12,8 @@ ops via the map (src/osdc/Objecter.cc). This package is the analog:
 - ``paxos``:    quorum-replicated commit for the monitor store.
 - ``osd_daemon`` / ``objecter``: the data-plane daemon serving client
                 ops and the map-aware resending client.
+- ``peering``:  the explicit per-PG peering state machine
+                (PeeringState.cc analog) + crash-point injection.
 """
 
 from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec, SHARD_NONE
@@ -19,11 +21,14 @@ from .mgr import Manager
 from .monitor import CommandError, Monitor
 from .objecter import IoCtx, NoPrimary, Objecter, RadosClient
 from .osd_daemon import OSDDaemon
+from .peering import PgPeeringFsm, crash_points
 from .striper import StripedIoCtx
 
 __all__ = [
     "Manager",
     "CommandError",
+    "PgPeeringFsm",
+    "crash_points",
     "Incremental",
     "IoCtx",
     "Monitor",

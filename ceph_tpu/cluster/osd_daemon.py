@@ -24,6 +24,15 @@ local shard stores carry (the object_info_t takeover path).
 Wrong-primary requests answer ``eagain`` + the daemon's epoch, and the
 client re-targets (Objecter resend contract, osdc/Objecter.cc:2127).
 
+Peering — the authoritative-log election, the self-rewind, interval
+fencing and returning-member admission — is driven by the per-PG
+state machine in ``cluster/peering.py`` (the PeeringState.cc analog;
+``osd_peering_fsm=false`` re-selects the legacy thread-and-flags path
+kept below for bisection). This module keeps the peering PRIMITIVES
+the FSM composes: ``_own_pg_info``, ``_bump_fence``,
+``_pgmeta_write_les``, ``_sub_write_interval_ok``, the PGInfo/
+PGActivate services, and ``_catch_up_shard``.
+
 Client ops are serialized by a daemon op lock (the reference serializes
 per-PG via op queues; the mClock scheduler seam slots in here).
 Peer-failure evidence flows to the monitor via ``report_failure``.
@@ -81,6 +90,7 @@ from ceph_tpu.utils import tracer
 from ceph_tpu.utils.mclock import MClockScheduler
 
 from .osdmap import OSDMap, SHARD_NONE
+from .peering import PgPeeringFsm, crash_points, make_peering_perf
 
 #: ops whose re-application a lost-reply resend must not repeat
 _MUTATING_OPS = frozenset(
@@ -428,6 +438,10 @@ class _PG:
         self.backfilling = False    # pg_temp installed, data moving
         self.backfill_dirty: set[str] = set()  # written mid-backfill
         self.backfill_done = False  # moved; drop on next map change
+        #: positions with a _catch_up_shard thread in flight (guarded
+        #: by daemon._pg_lock) — spawn sites dedup through this so a
+        #: shard is never caught up by two racing threads
+        self._catchup_inflight: set[int] = set()
         #: peering gate (the PG active state): client ops eagain until
         #: the serving primary has run the authoritative-log election
         #: for this interval. Non-primaries are trivially peered —
@@ -437,6 +451,14 @@ class _PG:
         self._repeer = False
         if first_live(acting) != daemon.osd_id:
             self.peered.set()
+        # explicit peering FSM (cluster/peering.py) unless the
+        # bisection escape hatch re-selects the legacy thread path
+        from ceph_tpu.utils import config as _cfg
+
+        self.fsm = (
+            PgPeeringFsm(daemon, self)
+            if _cfg.get("osd_peering_fsm") else None
+        )
         self.codec = registry.factory(spec.plugin, profile)
         chunk = daemon.chunk_size
         self.sinfo = StripeInfo(spec.k, spec.m, spec.k * chunk)
@@ -468,7 +490,7 @@ class _PG:
             lambda oid: daemon._object_size(self, oid),
             self.rmw.hinfo,
             perf_name=f"osd.{daemon.osd_id}.{pool}.{pg}.recovery",
-            user_attrs_fn=lambda oid: daemon._replicated_attrs(self, oid),
+            user_attrs_fn=lambda oid: daemon._recovery_attrs(self, oid),
             eversion_fn=lambda oid: daemon._authoritative_eversion(self, oid),
         )
 
@@ -499,6 +521,9 @@ class OSDDaemon:
         self.peers = NetShardBackend({}, secret=secret)
         #: coalescing observability + the sub-write frame-packing hook
         self.coalesce_pc = _coalesce_perf(f"osd.{osd_id}.coalesce")
+        #: peering observability (elections, rewinds, fence rejects,
+        #: state dwell times) — shared by the FSM and legacy paths
+        self.peering_pc = make_peering_perf(f"osd.{osd_id}.peering")
         self.peers.on_subwrite_batch = self._on_subwrite_batch
         # stamp my map interval into every sub-write (replica fence)
         self.peers.interval_fn = lambda: (
@@ -897,6 +922,8 @@ class OSDDaemon:
                 # open their gate — the primary's peering judges them.
                 if first_live(new_acting) == self.osd_id:
                     self._kick_peering(pg)
+                elif pg.fsm is not None:
+                    pg.fsm.post_interval()  # -> replica, gate open
                 else:
                     pg.peered.set()
                 if downed:
@@ -915,11 +942,36 @@ class OSDDaemon:
             for i in downed:
                 pg.rmw.on_shard_down(i)
         for pg, healed in to_recover:
+            if (
+                pg.fsm is not None
+                and first_live(pg.acting) != self.osd_id
+            ):
+                # FSM path: only the SERVING PRIMARY drives catch-up
+                # (the reference's recovery model). A demoted
+                # instance replaying ITS pglog onto a member of a PG
+                # someone else now leads raced the new primary's live
+                # writes — rebuild-at-T, push-at-T+δ lost updates
+                # clobbered freshly committed extents on one shard
+                # (the torn-RMW leg of ROADMAP #1, found by the
+                # primary-victim smoke). The new primary's election
+                # judges every member by its gathered infos and
+                # drains EVERY stale recovering mark itself (see
+                # _peer_pass), so marks left here are not leaked.
+                continue
             for shard in healed:
-                threading.Thread(
-                    target=self._catch_up_shard, args=(pg, shard),
-                    daemon=True,
-                ).start()
+                if (
+                    pg.fsm is not None
+                    and pg.acting[shard] == self.osd_id
+                ):
+                    # my OWN position healed: the FSM's election pass
+                    # (already kicked above) judges and repairs my
+                    # store and re-admits the position at Active —
+                    # the legacy path ran the replica catch-up
+                    # against itself here (an RPC to nobody), failed,
+                    # and holed its own primary position (THE
+                    # round-8 peering flake / ROADMAP #1 ENOENT)
+                    continue
+                self._spawn_catch_up(pg, shard)
         for pool, pgid, pg in maybe_backfill:
             if self._request_pg_temp(pool, pgid, pg):
                 self._start_backfill(pool, pgid, pg)
@@ -999,6 +1051,25 @@ class OSDDaemon:
             pg = self._get_pg(pool, pgid)
             self._start_backfill(pool, pgid, pg)
 
+    def _spawn_catch_up(self, pg: _PG, shard: int) -> None:
+        """Start a catch-up thread for one position, at most one in
+        flight per (pg, shard) — every spawn site (map healed
+        transition, tick re-heal, the FSM's behind-member and
+        stale-recovering drains) routes through here."""
+        with self._pg_lock:
+            if shard in pg._catchup_inflight:
+                return
+            pg._catchup_inflight.add(shard)
+
+        def run() -> None:
+            try:
+                self._catch_up_shard(pg, shard)
+            finally:
+                with self._pg_lock:
+                    pg._catchup_inflight.discard(shard)
+
+        threading.Thread(target=run, daemon=True).start()
+
     def _catch_up_shard(self, pg: _PG, shard: int) -> None:
         """Replay the op log onto a returned member until it is clean
         (writes racing the replay append new dirty entries — loop),
@@ -1015,6 +1086,27 @@ class OSDDaemon:
             # only established once the primary has peered
             if not pg.peered.wait(timeout=60):
                 raise RuntimeError("peering never completed")
+            if pg.fsm is not None and pg.acting[shard] == self.osd_id:
+                # my own position is the election's to admit, never a
+                # peer transfer (see _admit_self_positions); a stray
+                # spawn must not RPC to itself and hole the position
+                pg.fsm.post("retry")
+                return
+            crash_points.fire(
+                "catchup.pre_listing", daemon=self, pg=pg, shard=shard
+            )
+            # FSM path: every rebuild-and-push below holds _op_lock,
+            # serializing with the live write path — a push computed
+            # from survivors read at T must not land at T+δ over an
+            # extent a client write committed in between (the
+            # lost-update shard tear the primary-victim soak caught).
+            # Legacy keeps the unserialized pushes (escape hatch).
+            import contextlib
+
+            push_lock = (
+                self._op_lock if pg.fsm is not None
+                else contextlib.nullcontext()
+            )
             # Pristine member stamps, captured before any replay or
             # refresh can overwrite them (see _member_listing).
             member_listing = self._member_listing(pg, shard)
@@ -1044,11 +1136,13 @@ class OSDDaemon:
                     if not known:
                         # gone while the member was away: propagate
                         # the delete (its stale copy fed the scan)
-                        self._push_delete(target_osd, loc, shard)
+                        with push_lock:
+                            self._push_delete(target_osd, loc, shard)
                         continue
-                    pg.recovery.recover_object(
-                        loc, {shard}, size=size_hint
-                    )
+                    with push_lock:
+                        pg.recovery.recover_object(
+                            loc, {shard}, size=size_hint
+                        )
                     refreshed.add(loc)
                 pg.born_holes.discard(shard)
             def _dirty() -> bool:
@@ -1060,7 +1154,8 @@ class OSDDaemon:
 
             for _ in range(8):
                 self.admit("recovery")
-                pg.recovery.recover_from_log(pg.pglog, shard)
+                with push_lock:
+                    pg.recovery.recover_from_log(pg.pglog, shard)
                 if not _dirty():
                     break
             # Eversion divergence pass: log replay brings the member
@@ -1083,13 +1178,15 @@ class OSDDaemon:
                     "pg", f"{pg.pool}/{pg.pgid}:", "divergent object",
                     loc, "on shard", shard, "- rolling back"
                 )
-                pg.recovery.recover_object(loc, {shard})
+                with push_lock:
+                    pg.recovery.recover_object(loc, {shard})
             for loc in sorted(divergent_deletes):
                 self.log.info(
                     "pg", f"{pg.pool}/{pg.pgid}:", "divergent create",
                     loc, "on shard", shard, "- removing"
                 )
-                self._push_delete(target_osd, loc, shard)
+                with push_lock:
+                    self._push_delete(target_osd, loc, shard)
             # Admission happens under the op lock with a final clean
             # check: client writes (which also take _op_lock) cannot
             # append dirty entries between the check and the admit, so
@@ -1100,16 +1197,29 @@ class OSDDaemon:
             # which may itself be blocked on _op_lock (the backfill
             # final pass skips admission under the lock for the same
             # reason). A shard dirty even then reverts to a hole
-            # (except path below).
-            with self._op_lock:
-                if _dirty():
-                    pg.recovery.recover_from_log(pg.pglog, shard)
-                if _dirty():
+            # (except path below). On the FSM path the admission is an
+            # EVENT on the PG's peering queue — it cannot interleave
+            # an election, so a mid-judgment member can never vote.
+            crash_points.fire(
+                "catchup.pre_admit", daemon=self, pg=pg, shard=shard
+            )
+            if pg.fsm is not None:
+                if not pg.fsm.admit_caught_up(shard):
                     raise RuntimeError(
-                        f"shard {shard} still dirty after replay budget"
+                        f"shard {shard} admission rejected "
+                        "(interval moved or still dirty)"
                     )
-                pg.backend.recovering.discard(shard)
-                pg.rmw.on_shard_recovered(shard)
+            else:
+                with self._op_lock:
+                    if _dirty():
+                        pg.recovery.recover_from_log(pg.pglog, shard)
+                    if _dirty():
+                        raise RuntimeError(
+                            f"shard {shard} still dirty after replay "
+                            "budget"
+                        )
+                    pg.backend.recovering.discard(shard)
+                    pg.rmw.on_shard_recovered(shard)
             self.log.info(
                 "pg", f"{pg.pool}/{pg.pgid}:", "shard", shard,
                 "caught up, admitted"
@@ -1187,6 +1297,7 @@ class OSDDaemon:
                     ) % spec.pg_num
                     fence = self._fence_epochs.get((pool_id, pgid), 0)
                     if msg.epoch < fence:
+                        self.peering_pc.inc("interval_fences_rejected")
                         self.log.info(
                             "fence: sub-write from osd.", msg.from_osd,
                             f"e{msg.epoch} rejected:", loc,
@@ -1229,6 +1340,24 @@ class OSDDaemon:
 
     def _user_attrs(self, pg: _PG, oid: str) -> dict[str, bytes]:
         return self._replicated_attrs(pg, oid, ("u:",))
+
+    def _recovery_attrs(self, pg: _PG, oid: str) -> dict[str, bytes]:
+        """Attrs restored onto recovered shards: the replicated user/
+        omap attrs PLUS the reqid-dedup window. Without the window, a
+        member rebuilt after an absence keeps its ANCIENT ``rq`` attr
+        — and when it later becomes the primary it seeds suspect
+        reqids so old they have left every other member's window,
+        which classify ambiguous forever and wedge the object in
+        eagain (chaos-tier find; the legacy self-catch-up bug masked
+        this by accidentally seeding an empty window)."""
+        attrs = self._replicated_attrs(pg, oid)
+        key = self._my_key(pg, oid)
+        if key is not None:
+            try:
+                attrs[REQ_KEY] = self.store.getattr(key, REQ_KEY)
+            except (FileNotFoundError, KeyError):
+                pass
+        return attrs
 
     def _object_exists(self, pg: _PG, oid: str) -> bool:
         """The client-visible existence test the op handlers share."""
@@ -1498,6 +1627,13 @@ class OSDDaemon:
                 self._req_flush.add(
                     ("pg", spec.pool_id, spec.pg_num, pg.pgid)
                 )
+        if pg.fsm is not None:
+            # FSM path: the interval event serializes with every
+            # other peering event of this PG; the gate flips
+            # synchronously inside post_interval (ops eagain the
+            # moment the interval moves, like the legacy kick)
+            pg.fsm.post_interval()
+            return
         with self._peer_lock:
             pg.peered.clear()
             if pg._peering:
@@ -1554,6 +1690,7 @@ class OSDDaemon:
                 my_pos = acting0.index(self.osd_id)
             except ValueError:
                 return False  # no longer a member; a map re-kicks
+            self.peering_pc.inc("elections_run")
             infos: dict[int, tuple[int, tuple[int, int]]] = {}
             for idx, osd in enumerate(acting0):
                 if osd == SHARD_NONE:
@@ -1642,6 +1779,7 @@ class OSDDaemon:
         objects whose stamps are not in its history, remove my
         divergent creates (PGLog::rewind_divergent_log applied to the
         ex-primary itself)."""
+        self.peering_pc.inc("rewinds")
         listing = self.peers.list_pg(
             best, spec.pool_id, spec.pg_num, pg.pgid
         )
@@ -3393,8 +3531,12 @@ class OSDDaemon:
         with self._pg_lock:
             stuck = [
                 pg for pg in self._pgs.values()
-                if not pg.peered.is_set() and not pg._peering
+                if not pg.peered.is_set()
                 and first_live(pg.acting) == self.osd_id
+                and (
+                    not pg.fsm._draining if pg.fsm is not None
+                    else not pg._peering
+                )
             ]
         for pg in stuck:
             self._kick_peering(pg)
@@ -3429,10 +3571,12 @@ class OSDDaemon:
                 "pg", f"{pg.pool}/{pg.pgid}:", "re-healing shard",
                 shard, "(previous catch-up failed)"
             )
-            threading.Thread(
-                target=self._catch_up_shard, args=(pg, shard),
-                daemon=True,
-            ).start()
+            if pg.fsm is not None and pg.acting[shard] == self.osd_id:
+                # my own position: the election re-admits it (see
+                # _admit_self_positions) — never a transfer to self
+                pg.fsm.post_interval()
+                continue
+            self._spawn_catch_up(pg, shard)
 
     # -- background scrub scheduler (osd/scrubber/osd_scrub.cc role) ----
     def _scrub_due(

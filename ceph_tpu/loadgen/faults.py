@@ -17,6 +17,12 @@ import time
 from dataclasses import dataclass, field
 
 
+#: named victim pickers a kill event may carry instead of an osd id;
+#: resolved against the live cluster AT FIRE TIME (a pre-run pick
+#: would miss primaries reshuffled by earlier events)
+VICTIM_PICKERS = ("least_primary", "most_primary")
+
+
 @dataclass
 class FaultEvent:
     #: fire once the run's completed-op counter reaches this
@@ -25,13 +31,25 @@ class FaultEvent:
     #: mid-run — the multi-chip msgr fault; ``osd`` carries the host
     #: rank, default 1)
     action: str
-    #: target osd id; None = pick (kill: first live non-mon victim
-    #: in id order for determinism; revive: oldest corpse)
-    osd: int | None = None
+    #: target: an osd id, a named victim picker ("least_primary" |
+    #: "most_primary", kill only, resolved at fire time), or None =
+    #: pick (kill: first live victim in id order for determinism;
+    #: revive: oldest corpse)
+    osd: int | str | None = None
 
     def __post_init__(self) -> None:
         if self.action not in ("kill", "revive", "dcn_kill"):
             raise ValueError(f"unknown fault action {self.action!r}")
+        if isinstance(self.osd, str):
+            if self.action != "kill":
+                raise ValueError(
+                    f"named victim {self.osd!r} only targets kills"
+                )
+            if self.osd not in VICTIM_PICKERS:
+                raise ValueError(
+                    f"unknown victim picker {self.osd!r} "
+                    f"(know {VICTIM_PICKERS})"
+                )
 
 
 @dataclass
@@ -71,6 +89,8 @@ class FaultSchedule:
             return
         if ev.action == "kill":
             osd = ev.osd
+            if isinstance(osd, str):  # named picker, fire-time state
+                osd = getattr(cluster, osd + "_osd")()
             if osd is None:
                 live = sorted(cluster.live_osds())
                 if not live:
@@ -100,6 +120,26 @@ class FaultSchedule:
             self.revive_at = time.monotonic()
         if cluster.wait_recovered(self.recovery_timeout):
             self.recovered_at = time.monotonic()
+
+    @classmethod
+    def primary_kill(
+        cls, total_ops: int, recovery_timeout: float = 60.0
+    ) -> "FaultSchedule":
+        """The default soak schedule: kill the MOST-primary OSD a
+        third of the way in (maximum simultaneous takeovers — the
+        racy path the peering FSM exists for), revive it at two
+        thirds, and demand full recovery at settle. Soaks target the
+        takeover composition by default instead of dodging it."""
+        return cls(
+            [
+                FaultEvent(
+                    max(total_ops // 3, 1), "kill",
+                    osd="most_primary",
+                ),
+                FaultEvent(max((2 * total_ops) // 3, 2), "revive"),
+            ],
+            recovery_timeout=recovery_timeout,
+        )
 
     def metrics(self, recorder) -> dict:
         """Degraded-window throughput + time-to-recovered rows."""

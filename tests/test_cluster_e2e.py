@@ -275,6 +275,32 @@ def test_log_blind_return_gets_full_refresh(cluster):
     data2 = payload(9_000, seed=9)
     io.write("obj", data2)    # the new log never saw member's gap
     mon.osd_boot(member, daemons[member].addr)  # full refresh path
+    # wait for the refresh to LAND (the member admitted back into
+    # the serving set) — killing survivors while the refresh is
+    # mid-flight makes the rebuild impossible (fewer than k sources)
+    # and turns the test into a coin flip on thread scheduling (the
+    # round-8 "log_blind_return" flake, reproduced on the seed)
+    import time
+
+    pgid = mon.osdmap.object_to_pg("ecpool", "obj")
+
+    def _refreshed() -> bool:
+        acting = mon.osdmap.object_to_acting("ecpool", "obj")
+        prim = next((o for o in acting if o != -1), None)
+        if prim is None:
+            return False
+        pg = daemons[prim]._pgs.get(("ecpool", pgid))
+        return (
+            pg is not None
+            and member in pg.acting
+            and not pg.backend.recovering
+            and pg.peered.is_set()
+        )
+
+    end = time.monotonic() + 20
+    while not _refreshed() and time.monotonic() < end:
+        time.sleep(0.05)
+    assert _refreshed(), "returned member never re-admitted"
     # force reads through the refreshed member: down enough others
     # that decode MUST use its shard
     others = [
@@ -285,9 +311,6 @@ def test_log_blind_return_gets_full_refresh(cluster):
     for o in others[2:]:
         daemons[o].stop()
         mon.osd_down(o)
-    # the refresh runs on a worker thread: poll until it lands
-    import time
-
     end = time.monotonic() + 20
     while True:
         try:

@@ -208,6 +208,173 @@ def test_interval_without_client_io_still_activates(cluster):
     ), "divergent bytes survived a no-IO interval return"
 
 
+# -- the pinned takeover interleaving (ROADMAP #1) ----------------------
+# The loadgen-observed composition: a primary dies mid-run; writes
+# commit through the interim primary; the ex-primary returns (map-order
+# primary again). The legacy thread-and-flags peering then ran the
+# replica catch-up against ITSELF (peers.list_pg to its own id — an
+# RPC to nobody), failed, and reverted its own primary position to a
+# hole: committed reads answered ENOENT and the un-reconciled shard
+# tore write_full stripes around the phantom hole. ~5% per loadgen
+# roll with a primary victim; deterministic here via the peering FSM's
+# crash points (FSM path) and the always-failing self-RPC (legacy
+# path). The FSM path must survive the interleaving; the legacy
+# escape hatch must still REPRODUCE it (that is what makes it a
+# bisection hatch).
+
+def _boot_cluster(tick_period: float):
+    mon = Monitor()
+    daemons = []
+    for i in range(6):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+    for i in range(6):
+        d = OSDDaemon(i, mon, chunk_size=1024, tick_period=tick_period)
+        d.start()
+        daemons.append(d)
+    mon.osd_erasure_code_profile_set(
+        "rs32", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": "3", "m": "2"}
+    )
+    mon.osd_pool_create("ecpool", 8, "rs32")
+    client = RadosClient(mon, backoff=0.01)
+    return mon, daemons, client
+
+
+def _takeover_sequence(mon, daemons, io):
+    """write v1 -> primary marked down (daemon keeps running) ->
+    write_full v2 through the interim -> ex-primary booted back.
+    Returns (ex_primary daemon, pgid, v2 bytes)."""
+    v1 = payload(5_000, seed=21)
+    io.write("obj", v1)
+    acting = mon.osdmap.object_to_acting("ecpool", "obj")
+    ex_primary = acting[0]
+    dxp = next(dd for dd in daemons if dd.osd_id == ex_primary)
+    pgid = mon.osdmap.object_to_pg("ecpool", "obj")
+    mon.osd_down(ex_primary)
+    # committed while the ex-primary is away: a SHORTER object, so a
+    # stale-shard mixture would show as a torn head/tail mismatch
+    v2 = payload(900, seed=22)
+    io.write_full("obj", v2)
+    mon.osd_boot(ex_primary, dxp.addr)
+    assert mon.osdmap.object_to_acting("ecpool", "obj")[0] == ex_primary
+    return dxp, pgid, v2
+
+
+def _obj_route(mon):
+    """(primary osd id, pgid) the committed object routes to — known
+    before any write, so crash points can be armed pg-exactly."""
+    return (
+        mon.osdmap.object_to_acting("ecpool", "obj")[0],
+        mon.osdmap.object_to_pg("ecpool", "obj"),
+    )
+
+
+def test_fsm_pins_takeover_interleaving():
+    """FSM path (default): the returning ex-primary reconciles BEFORE
+    serving — the GetMissing crash point holds the pass mid-
+    reconciliation and the gate provably stays closed; on release the
+    committed-read returns exactly v2 (no ENOENT, no stale tail) and
+    the primary position never holes. tick_period=0: the FSM must not
+    need the re-heal tick as a crutch."""
+    from ceph_tpu.cluster.peering import crash_points
+
+    mon, daemons, client = _boot_cluster(tick_period=0.0)
+    io = client.open_ioctx("ecpool")
+    try:
+        ex, pgid0 = _obj_route(mon)
+        # pg-exact arm: the returned ex-primary reconciles EVERY pg it
+        # leads — only the committed object's pg may consume the point
+        cp = crash_points.arm(
+            "peering.getmissing.pre_rewind", "pause",
+            osd=ex, pool="ecpool", pgid=pgid0, pause_cap=20.0,
+        )
+        dxp, pgid, v2 = _takeover_sequence(mon, daemons, io)
+        assert pgid == pgid0
+        # the pass parks mid-reconciliation: the gate MUST be closed
+        # (serving here is exactly the torn-write window)
+        assert cp.wait_hit(10.0), "getmissing crash point never hit"
+        pg = dxp._pgs.get(("ecpool", pgid))
+        assert pg is not None and not pg.peered.is_set(), (
+            "gate open while the ex-primary is mid-reconciliation"
+        )
+        cp.release()
+        assert io.read("obj") == v2, (
+            "returned ex-primary served torn/stale bytes"
+        )
+        # the position never holed (the legacy failure mode)
+        assert pg.acting[0] == dxp.osd_id
+        assert _wait(lambda: pg.peered.is_set())
+        assert pg.fsm.state == "active"
+    finally:
+        crash_points.clear()
+        client.shutdown()
+        for d in daemons:
+            d.stop()
+
+
+def test_legacy_escape_hatch_reproduces_enoent_hole():
+    """Escape hatch (osd_peering_fsm=false): the SAME sequence
+    reproduces the pinned bug — the returned ex-primary lands in one
+    of the race's two terminal shapes (tick_period=0 keeps the
+    re-heal tick from papering over either):
+
+    - HOLED: the self-catch-up RPC-to-nobody failed and reverted its
+      own primary position to a hole — the committed read answers
+      ENOENT (the loadgen observable);
+    - WEDGED: the thread-and-flags election lost a wakeup and the
+      gate never opens — the committed read exhausts its retries.
+
+    Either way the committed object is unserviceable through the
+    map-order primary; on the FSM path (previous test) the identical
+    sequence serves it exactly."""
+    from ceph_tpu.cluster.osdmap import SHARD_NONE
+    from ceph_tpu.utils import config
+
+    with config.override(osd_peering_fsm=False):
+        mon, daemons, client = _boot_cluster(tick_period=0.0)
+        io = client.open_ioctx("ecpool")
+        reader = None
+        try:
+            dxp, pgid, v2 = _takeover_sequence(mon, daemons, io)
+
+            def pg_of():
+                return dxp._pgs.get(("ecpool", pgid))
+
+            def broken():
+                pg = pg_of()
+                if pg is None:
+                    return False
+                if pg.acting[0] == SHARD_NONE:
+                    return True  # holed: self catch-up failed
+                return (
+                    not pg.peered.is_set() and not pg._peering
+                )  # wedged: election died, nothing retries
+
+            assert _wait(broken, timeout=12.0), (
+                "legacy path served the takeover cleanly "
+                "(bug fixed? retire the escape hatch)"
+            )
+            time.sleep(0.3)
+            assert broken(), "transient blip, not the pinned wedge"
+            # the committed read cannot be served correctly: enoent
+            # when holed, retry exhaustion when wedged
+            from ceph_tpu.cluster.objecter import NoPrimary
+
+            reader = RadosClient(
+                mon, backoff=0.01, op_timeout=2.0, max_attempts=3
+            )
+            with pytest.raises((FileNotFoundError, IOError,
+                                TimeoutError, NoPrimary)):
+                data = reader.open_ioctx("ecpool").read("obj")
+                assert data != v2, "read served committed bytes"
+        finally:
+            if reader is not None:
+                reader.shutdown()
+            client.shutdown()
+            for d in daemons:
+                d.stop()
+
+
 def test_election_prefers_highest_les_then_lu(cluster):
     """Unit-level: _peer_pg's ordering is (les, last_update), ties
     prefer self then lowest osd id."""

@@ -138,3 +138,80 @@ class TestLiveCluster:
             client.shutdown()
             for d in daemons:
                 d.stop()
+
+
+class TestPeeringCounters:
+    def test_peering_set_rendered(self):
+        """The peering counter set (elections_run, rewinds,
+        interval_fences_rejected, state_dwell_ms histogram,
+        peering_ms avg) renders in exposition format with the
+        ``osd.<id>.peering`` set label — the soak dashboards' surface."""
+        from ceph_tpu.cluster.peering import make_peering_perf
+        from ceph_tpu.utils.perf_counters import perf_collection
+
+        # a uniquely-named set in the process-global collection (the
+        # surface the real exporter scrapes); deregistered at the end
+        pc = make_peering_perf("osd.77.peering")
+        pc.inc("elections_run", 3)
+        pc.inc("rewinds")
+        pc.inc("interval_fences_rejected", 2)
+        pc.hinc("state_dwell_ms", 1.7)
+        pc.ainc("peering_ms", 12.5)
+        try:
+            text = render_exposition()
+        finally:
+            perf_collection.deregister("osd.77.peering")
+        samples = parse_exposition(text)
+        label = 'set="osd.77.peering"'
+        assert samples[f"ceph_tpu_elections_run{{{label}}}"] == 3
+        assert samples[f"ceph_tpu_rewinds{{{label}}}"] == 1
+        assert samples[
+            f"ceph_tpu_interval_fences_rejected{{{label}}}"
+        ] == 2
+        assert samples[f"ceph_tpu_peering_ms_sum{{{label}}}"] == 12.5
+        assert samples[f"ceph_tpu_peering_ms_count{{{label}}}"] == 1
+        # the dwell histogram emits cumulative buckets + sum
+        assert samples[
+            f"ceph_tpu_state_dwell_ms_count{{{label}}}"
+        ] == 1
+        assert samples[
+            f"ceph_tpu_state_dwell_ms_sum{{{label}}}"
+        ] == pytest.approx(1.7)
+
+    def test_live_cluster_peering_metrics(self):
+        """A served cluster exports real election counts through the
+        process-global collection."""
+        import numpy as np
+
+        from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+        from ceph_tpu.utils.exporter import render_exposition as rend
+
+        mon = Monitor()
+        daemons = []
+        for i in range(4):
+            mon.osd_crush_add(i, zone=f"z{i % 2}")
+        for i in range(4):
+            d = OSDDaemon(i, mon, chunk_size=1024)
+            d.start()
+            daemons.append(d)
+        mon.osd_erasure_code_profile_set(
+            "rs21p", {"plugin": "isa", "k": "2", "m": "1"}
+        )
+        mon.osd_pool_create("pp", 4, "rs21p")
+        client = RadosClient(mon, backoff=0.01)
+        try:
+            io = client.open_ioctx("pp")
+            rng = np.random.default_rng(6)
+            io.write("p0", rng.integers(0, 256, 2048, np.uint8).tobytes())
+            samples = parse_exposition(rend())
+            elections = {
+                k: v for k, v in samples.items()
+                if k.startswith("ceph_tpu_elections_run")
+                and ".peering" in k
+            }
+            assert elections, "no peering counter sets exported"
+            assert sum(elections.values()) >= 1
+        finally:
+            client.shutdown()
+            for d in daemons:
+                d.stop()

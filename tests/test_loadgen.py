@@ -189,11 +189,13 @@ def smoke_run():
             total_ops=80, warmup_ops=8, popularity="zipfian",
             seed=7,
         )
-        # the deterministic gate kills a non-primary member: degraded
-        # reads + catch-up + recovery clock all exercise, without
-        # rolling the known primary-takeover race dice (the full
-        # primary-kill thrash is the slow-tier test)
-        victim = cluster.least_primary_osd()
+        # the gate kills the MOST-primary member: every one of its
+        # PGs runs a takeover election mid-run, and the revive forces
+        # the returning ex-primary back through peering. The round-8
+        # smoke deliberately killed a non-primary to dodge the
+        # takeover race; the peering FSM closed it (ROADMAP #1), so
+        # the racy path is now the default CI target.
+        victim = cluster.most_primary_osd()
         faults = FaultSchedule(
             [FaultEvent(26, "kill", osd=victim),
              FaultEvent(53, "revive", osd=victim)],

@@ -1,0 +1,336 @@
+"""The per-PG peering FSM (cluster/peering.py): state progression,
+event serialization, crash-point injection (pause/fail/kill), the
+peering perf-counter set, catch-up admission gating, and the named
+loadgen victim pickers the soak tier targets."""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
+from ceph_tpu.cluster.peering import (
+    ACTIVE,
+    CrashPointAbort,
+    GETINFO,
+    GETLOG,
+    INCOMPLETE,
+    REPLICA,
+    crash_points,
+)
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8
+    ).tobytes()
+
+
+def _wait(pred, timeout=15.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+@pytest.fixture
+def cluster():
+    mon = Monitor()
+    daemons = []
+    for i in range(5):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+    for i in range(5):
+        d = OSDDaemon(i, mon, chunk_size=1024, tick_period=0.3)
+        d.start()
+        daemons.append(d)
+    mon.osd_erasure_code_profile_set(
+        "rs21", {"plugin": "jerasure", "technique": "reed_sol_van",
+                 "k": "2", "m": "1"}
+    )
+    mon.osd_pool_create("fsmpool", 4, "rs21")
+    client = RadosClient(mon, backoff=0.01)
+    yield mon, daemons, client
+    crash_points.clear()
+    client.shutdown()
+    for d in daemons:
+        d.stop()
+
+
+def _primary_pg(mon, daemons, oid="obj"):
+    pgid = mon.osdmap.object_to_pg("fsmpool", oid)
+    primary = mon.osdmap.object_to_acting("fsmpool", oid)[0]
+    d = next(dd for dd in daemons if dd.osd_id == primary)
+    return d, pgid
+
+
+class TestStates:
+    def test_progression_to_active(self, cluster):
+        """A served PG's FSM sits in ``active`` having walked the
+        canonical ladder — getinfo and getlog appear in the trail."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(3000))
+        d, pgid = _primary_pg(mon, daemons)
+        pg = d._pgs[("fsmpool", pgid)]
+        assert pg.fsm is not None
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        visited = {s for _frm, s in pg.fsm.history}
+        assert GETINFO in visited and GETLOG in visited
+
+    def test_replica_instances_trivially_peered(self, cluster):
+        """A non-primary member's instance parks in ``replica`` with
+        the gate open (sub-ops are the peered primary's problem)."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(1000))
+        acting = mon.osdmap.object_to_acting("fsmpool", "obj")
+        member = acting[1]
+        dm = next(dd for dd in daemons if dd.osd_id == member)
+        pgid = mon.osdmap.object_to_pg("fsmpool", "obj")
+        # replicas instantiate lazily; poke one into existence
+        pg = dm._get_pg("fsmpool", pgid)
+        assert _wait(lambda: pg.fsm.state == REPLICA)
+        assert pg.peered.is_set()
+
+    def test_counters_on_perf_dump(self, cluster):
+        """elections_run / peering_ms land on the admin-socket perf
+        dump under ``osd.<id>.peering``."""
+        from ceph_tpu.utils.admin_socket import admin_socket
+
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(1000))
+        d, pgid = _primary_pg(mon, daemons)
+        pg = d._pgs[("fsmpool", pgid)]
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        dump = admin_socket.execute("perf dump")
+        peering = dump[f"osd.{d.osd_id}.peering"]
+        assert peering["elections_run"] >= 1
+        assert peering["peering_ms"]["avgcount"] >= 1
+        assert sum(peering["state_dwell_ms"]["counts"]) > 0
+
+    def test_fence_rejection_counted(self, cluster):
+        """A sub-write stamped with a superseded interval epoch is
+        rejected AND counted (interval_fences_rejected)."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(1000))
+        d, pgid = _primary_pg(mon, daemons)
+        spec = mon.osdmap.pools["fsmpool"]
+        before = d.peering_pc.get("interval_fences_rejected")
+        d._fence_epochs[(spec.pool_id, pgid)] = 10_000
+        stale = types.SimpleNamespace(from_osd=1, epoch=1)
+        loc = f"{spec.pool_id}:obj"
+        assert d._sub_write_interval_ok(stale, loc) is False
+        assert d.peering_pc.get(
+            "interval_fences_rejected"
+        ) == before + 1
+
+
+class TestCrashPoints:
+    def test_pause_holds_the_gate(self, cluster):
+        """An armed pause inside Activating provably holds the gate
+        closed; release opens it — deterministic interleaving
+        control, the whole point of the crash points."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(2000))
+        d, pgid = _primary_pg(mon, daemons)
+        pg = d._pgs[("fsmpool", pgid)]
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        cp = crash_points.arm(
+            "peering.activating.pre_les", "pause",
+            osd=d.osd_id, pool="fsmpool", pgid=pgid, pause_cap=15.0,
+        )
+        # force a new interval: down/up a non-primary member
+        victim = next(
+            o for o in mon.osdmap.object_to_acting("fsmpool", "obj")[1:]
+            if o is not None
+        )
+        dv = next(dd for dd in daemons if dd.osd_id == victim)
+        mon.osd_down(victim)
+        mon.osd_boot(victim, dv.addr)
+        assert cp.wait_hit(10.0), "activating crash point never hit"
+        assert not pg.peered.is_set(), (
+            "gate open while activation is parked at the crash point"
+        )
+        cp.release()
+        assert _wait(lambda: pg.peered.is_set())
+        assert io.read("obj") == payload(2000)
+
+    def test_fail_parks_incomplete_and_tick_retries(self, cluster):
+        """A ``fail`` action aborts the transition (state
+        ``incomplete``, gate closed); the tick re-kicks and the next
+        pass completes — the retry seam is real."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(2000))
+        d, pgid = _primary_pg(mon, daemons)
+        pg = d._pgs[("fsmpool", pgid)]
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        crash_points.arm(
+            "peering.getinfo.pre_fence", "fail",
+            osd=d.osd_id, pool="fsmpool", pgid=pgid, count=1,
+        )
+        pg.fsm.post_interval()
+        assert _wait(lambda: pg.fsm.state == INCOMPLETE, 5.0)
+        # the armed point is consumed (count=1): the tick retry runs
+        # a clean pass and re-opens the gate
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        assert pg.peered.is_set()
+
+    def test_kill_stops_the_daemon(self, cluster):
+        """A ``kill`` action hard-stops the daemon mid-transition
+        (the ceph_abort analog) — the cluster's failure detection
+        takes it from there."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(2000))
+        d, pgid = _primary_pg(mon, daemons)
+        crash_points.arm(
+            "peering.getinfo.pre_fence", "kill",
+            osd=d.osd_id, pool="fsmpool", pgid=pgid, count=1,
+        )
+        pg = d._pgs[("fsmpool", pgid)]
+        pg.fsm.post_interval()
+        assert _wait(lambda: d._stopped, 10.0), (
+            "kill crash point did not stop the daemon"
+        )
+
+    def test_unarmed_fire_is_free_and_filters_hold(self):
+        """fire() with nothing armed is a no-op; filters (osd, pool,
+        pgid) must match for a point to consume."""
+        crash_points.fire("peering.reset")  # nothing armed: no-op
+        hits = []
+        cp = crash_points.arm(
+            "x.point", lambda **kw: hits.append(kw), osd=3, count=None,
+        )
+        try:
+            fake3 = types.SimpleNamespace(osd_id=3)
+            fake4 = types.SimpleNamespace(osd_id=4)
+            crash_points.fire("x.point", daemon=fake4)  # filtered out
+            assert hits == []
+            crash_points.fire("x.point", daemon=fake3)
+            assert len(hits) == 1
+            crash_points.fire("other.point", daemon=fake3)
+            assert len(hits) == 1
+        finally:
+            crash_points.clear()
+        assert cp.hits == 1
+
+    def test_bad_action_rejected(self):
+        with pytest.raises(ValueError):
+            crash_points.arm("x", "explode")
+
+    def test_abort_is_an_exception(self):
+        with pytest.raises(CrashPointAbort):
+            cp = crash_points.arm("y.point", "fail")
+            try:
+                crash_points.fire("y.point")
+            finally:
+                crash_points.clear()
+        assert cp.hits == 1
+
+
+class TestAdmission:
+    def test_admission_rejected_for_holed_position(self, cluster):
+        """catchup_admit for a position that is no longer a live
+        member answers False — the caller reverts to a hole and the
+        tick re-heals it under the current interval."""
+        from ceph_tpu.cluster.osdmap import SHARD_NONE
+
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(1000))
+        d, pgid = _primary_pg(mon, daemons)
+        pg = d._pgs[("fsmpool", pgid)]
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        pos = next(
+            i for i, o in enumerate(pg.acting) if o != d.osd_id
+        )
+        saved = pg.acting[pos]
+        pg.acting[pos] = SHARD_NONE
+        try:
+            assert pg.fsm.admit_caught_up(pos, timeout=5.0) is False
+        finally:
+            pg.acting[pos] = saved
+
+    def test_event_burst_serializes_to_active(self, cluster):
+        """A burst of concurrent interval/retry events from many
+        threads drains to a single consistent Active — no torn gate,
+        no deadlock (the serialization property, stress-shaped)."""
+        mon, daemons, client = cluster
+        io = client.open_ioctx("fsmpool")
+        io.write("obj", payload(1000))
+        d, pgid = _primary_pg(mon, daemons)
+        pg = d._pgs[("fsmpool", pgid)]
+        assert _wait(lambda: pg.fsm.state == ACTIVE)
+        threads = [
+            threading.Thread(target=pg.fsm.post_interval)
+            for _ in range(8)
+        ] + [
+            threading.Thread(target=pg.fsm.post, args=("retry",))
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+        assert _wait(lambda: pg.fsm.state == ACTIVE, 20.0)
+        assert pg.peered.is_set()
+        assert io.read("obj") == payload(1000)
+
+
+class TestVictimPickers:
+    def test_most_and_least_primary_pickers(self):
+        from ceph_tpu.loadgen import LoadCluster
+
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=8, chunk_size=1024,
+        )
+        try:
+            counts = cluster._primary_counts()
+            most = cluster.most_primary_osd()
+            least = cluster.least_primary_osd()
+            assert counts[most] == max(counts.values())
+            assert counts[least] == min(counts.values())
+            # ties break to the lowest id, deterministically
+            assert most == min(
+                o for o, c in counts.items() if c == counts[most]
+            )
+        finally:
+            cluster.shutdown()
+
+    def test_primary_kill_schedule_resolves_at_fire_time(self):
+        from ceph_tpu.loadgen.faults import FaultEvent, FaultSchedule
+
+        sched = FaultSchedule.primary_kill(90)
+        assert [e.at_op for e in sched.events] == [30, 60]
+        assert sched.events[0].osd == "most_primary"
+
+        class _FakeCluster:
+            def __init__(self):
+                self.killed = []
+
+            def most_primary_osd(self):
+                return 7
+
+            def kill(self, osd):
+                self.killed.append(osd)
+
+        fake = _FakeCluster()
+        sched.maybe_fire(31, fake)
+        assert fake.killed == [7]
+        assert sched.killed == [7]
+
+    def test_named_victim_validation(self):
+        from ceph_tpu.loadgen.faults import FaultEvent
+
+        with pytest.raises(ValueError):
+            FaultEvent(1, "revive", osd="most_primary")
+        with pytest.raises(ValueError):
+            FaultEvent(1, "kill", osd="median_primary")

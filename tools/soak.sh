@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Soak gate for the peering/quorum tiers (ISSUE 8 acceptance: 50
+# consecutive green runs under parallel load, zero flakes).
+#
+# Reruns the churn-sensitive suites N times while a loadgen smoke
+# loop (primary-victim kill/revive, bench_cli loadgen --smoke) keeps
+# the machine under real cluster load — thread-scheduling pressure is
+# what historically rolled the peering-race dice. Fails on the FIRST
+# non-green iteration, printing which one.
+#
+#   tools/soak.sh            # 50 iterations (the acceptance gate)
+#   tools/soak.sh 10         # quicker local soak
+#   SOAK_SUITES="tests/test_cluster_peering.py" tools/soak.sh 20
+#   SOAK_NO_LOAD=1 tools/soak.sh 5   # skip the background load loop
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+N=${1:-50}
+SUITES=${SOAK_SUITES:-"tests/test_cluster_peering.py tests/test_mon_quorum.py tests/test_peering_fsm.py"}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+LOAD_PID=""
+if [ -z "${SOAK_NO_LOAD:-}" ]; then
+    (
+        while true; do
+            python -m ceph_tpu.bench_cli loadgen --smoke \
+                >/dev/null 2>&1 || true
+        done
+    ) &
+    LOAD_PID=$!
+    echo "soak: background loadgen smoke loop pid=$LOAD_PID"
+fi
+cleanup() {
+    if [ -n "$LOAD_PID" ]; then
+        kill "$LOAD_PID" 2>/dev/null || true
+        wait "$LOAD_PID" 2>/dev/null || true
+    fi
+}
+trap cleanup EXIT
+
+for i in $(seq 1 "$N"); do
+    echo "== soak iteration $i/$N: $SUITES =="
+    if ! python -m pytest $SUITES -q -m 'not slow' \
+        -p no:cacheprovider -p no:randomly; then
+        echo "SOAK FAILED at iteration $i/$N"
+        exit 1
+    fi
+done
+echo "SOAK GREEN: $N consecutive runs of [$SUITES] under parallel load"
