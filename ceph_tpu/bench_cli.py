@@ -132,6 +132,21 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     lg.add_argument("--device-clock", action="store_true",
                     help="report small-op p99 from the device clock "
                          "(tunnel-RTT independent)")
+    lg.add_argument("--net-fault", default="none",
+                    choices=["none", "flaky", "partition"],
+                    help="arm the seeded network-fault plane: 'flaky' "
+                         "layers >=2%% drop + dup + ~50 ms p95 delay on "
+                         "every inter-OSD link between the fire/settle "
+                         "offsets; 'partition' asymmetrically cuts the "
+                         "--victim OSD off the data plane and merges it "
+                         "back (both deterministic from --seed)")
+    lg.add_argument("--net-drop", type=float, default=0.02,
+                    help="flaky profile drop probability per frame")
+    lg.add_argument("--net-dup", type=float, default=0.02,
+                    help="flaky profile duplication probability")
+    lg.add_argument("--net-delay-ms", type=float, default=5.0,
+                    help="flaky profile base delay (+ jitter to ~50 ms "
+                         "p95)")
     lg.add_argument("--seed", type=int, default=0xEC)
     lg.add_argument("--coalesce", choices=["on", "off"], default="on",
                     help="per-OSD-tick op coalescing (A/B flag: run "
@@ -363,6 +378,20 @@ def _run_loadgen(args) -> tuple[float, float]:
         m = int(profile.get("m", "2"))
         osds, chunk = args.osds, args.chunk_size
         fault_at, revive_at = args.fault_at, args.revive_at
+    from ceph_tpu.utils import config as _config
+
+    net_fault = getattr(args, "net_fault", "none")
+    overrides = dict(osd_op_coalescing=(args.coalesce == "on"))
+    if net_fault != "none":
+        # lost frames must resolve inside the client's resend
+        # ladder, not a 10 s peer-RPC stall per drop (daemons read
+        # these at boot — the override wraps cluster creation); the
+        # sub-op retransmit ladder arms so a single lost sub-write
+        # ack costs ~0.2 s, not an op park
+        overrides["osd_peer_rpc_timeout"] = 1.0
+        overrides["osd_subop_resend_interval"] = 0.2
+    _override_ctx = _config.override(**overrides)
+    _override_ctx.__enter__()
     cluster = LoadCluster(
         n_osds=osds, k=k, m=m,
         pg_num=(args.pg_num if not args.smoke else 4),
@@ -383,14 +412,41 @@ def _run_loadgen(args) -> tuple[float, float]:
                 FaultEvent(at_op=revive_at, action="revive")
             )
         schedule = FaultSchedule(events)
-    from ceph_tpu.utils import config as _config
-
+    if net_fault == "flaky":
+        net_sched = FaultSchedule.net_flaky(
+            spec.total_ops, seed=args.seed, drop=args.net_drop,
+            dup=args.net_dup, delay_ms=args.net_delay_ms,
+        )
+        if schedule is None:
+            schedule = net_sched
+        else:  # chaos composition: churn x lossy links, one schedule
+            schedule = FaultSchedule(
+                schedule.events + net_sched.events,
+                recovery_timeout=schedule.recovery_timeout,
+            )
+    elif net_fault == "partition":
+        part_victim = (
+            args.fault_osd if args.fault_osd != -1 else args.victim
+        )
+        schedule = FaultSchedule.net_partition(
+            spec.total_ops, victim=part_victim, seed=args.seed,
+        )
     try:
-        with _config.override(
-            osd_op_coalescing=(args.coalesce == "on")
-        ):
-            report = run_spec(cluster, spec, schedule)
+        report = run_spec(cluster, spec, schedule)
         report["coalesce"] = args.coalesce
+        if net_fault != "none":
+            from ceph_tpu.msg.messenger import net_faults
+
+            report["net_fault"] = net_fault
+            report["net_fault_counters"] = dict(net_faults.counters)
+            report["net_dedup_hits"] = sum(
+                d.net_pc.get("dedup_hits")
+                for d in cluster.daemons.values()
+            )
+            report["net_resends_absorbed"] = sum(
+                d.net_pc.get("resends_absorbed")
+                for d in cluster.daemons.values()
+            )
         report["op_coalesced"] = sum(
             d.coalesce_pc.get("op_coalesced")
             for d in cluster.daemons.values()
@@ -411,6 +467,7 @@ def _run_loadgen(args) -> tuple[float, float]:
             )
     finally:
         cluster.shutdown()
+        _override_ctx.__exit__(None, None, None)
     print(json.dumps(report, sort_keys=True), file=sys.stderr)
     return report["duration_s"], report["bytes"] / 1024
 

@@ -41,6 +41,7 @@ Peer-failure evidence flows to the monitor via ``report_failure``.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 
 from ceph_tpu.msg.messages import (
@@ -186,6 +187,68 @@ def _coalesce_perf(name: str):
     )
 
 
+def make_net_perf(name: str):
+    """The per-daemon ``net`` counter set (``perf dump`` section
+    ``osd.<id>.net``, Prometheus via the exporter): what the seeded
+    fault plane did to this daemon's links, and what the dedup tiers
+    absorbed — the observability half of the chaos contract (injected
+    faults MUST show up here, absorbed duplicates MUST show up there,
+    and the ledger still balances exactly-once)."""
+    from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+    return (
+        PerfCountersBuilder(perf_collection, name)
+        .add_u64_counter(
+            "frames_dropped", "frames dropped by fault injection"
+        )
+        .add_u64_counter(
+            "frames_delayed", "frames delayed by fault injection"
+        )
+        .add_u64_counter(
+            "frames_duped", "frames duplicated by fault injection"
+        )
+        .add_u64_counter(
+            "frames_reordered", "frames reordered by fault injection"
+        )
+        .add_u64_counter(
+            "resends_absorbed",
+            "duplicate/straggler sub-write acks with no pending op",
+        )
+        .add_u64_counter(
+            "dedup_hits",
+            "resent client mutations replayed from the reqid cache",
+        )
+        .create_perf_counters()
+    )
+
+
+def make_rmw_crash_perf(name: str):
+    """The per-daemon ``rmw_crash`` counter set (``perf dump`` section
+    ``osd.<id>.rmw_crash``): how replay converged state after a
+    mid-commit crash — log entries rolled FORWARD onto returning
+    members, divergent objects rolled BACK to the elected authority,
+    and divergent creates removed."""
+    from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+    return (
+        PerfCountersBuilder(perf_collection, name)
+        .add_u64_counter(
+            "rollforwards",
+            "objects replayed forward from the pg log onto a "
+            "returning member",
+        )
+        .add_u64_counter(
+            "rollbacks",
+            "divergent objects rebuilt from survivors on replay",
+        )
+        .add_u64_counter(
+            "divergent_removes",
+            "divergent creates removed on replay",
+        )
+        .create_perf_counters()
+    )
+
+
 def make_loc(pool_id: int, oid: str) -> str:
     """Pool-scoped store key: two pools writing the same client oid
     must not collide in an OSD's flat object namespace (the hobject's
@@ -293,12 +356,24 @@ class _PGBackend:
 
     def avail_shards(self) -> set[int]:
         net_up = self.daemon.peers.avail_shards() | {self.daemon.osd_id}
-        return {
-            i
-            for i, osd in enumerate(self.acting)
-            if osd != SHARD_NONE and osd in net_up
-            and i not in self.recovering
-        }
+        out = set()
+        for i, osd in enumerate(self.acting):
+            if osd == SHARD_NONE or i in self.recovering:
+                continue
+            if osd in net_up:
+                out.add(i)
+            elif self.daemon.osdmap.is_up(osd):
+                # LOCALLY down-marked but the map says up: a lossy-link
+                # transient, not a death. Quarantine the position —
+                # writes hole-journal around it NOW, and once the
+                # recheck probe clears the mark the tick's catch-up
+                # replays what it missed and re-admits it. Without
+                # this, the mark clearing silently returned a member
+                # whose store missed every write of the mark window to
+                # the READ set: one stale chunk, torn decodes (the
+                # kill x net_flaky composition found it).
+                self.recovering.add(i)
+        return out
 
     def read_shard_async(self, shard, oid, extents, cb) -> None:
         osd = self.acting[shard]
@@ -471,6 +546,9 @@ class _PG:
         )
         # writes stamp (epoch, tid) eversions into OI attrs
         self.rmw.epoch = daemon.osdmap.epoch
+        # RMW crash points (rmw.prepare_done / primary_before_commit)
+        # fire with the owning daemon so osd= filters and kill resolve
+        self.rmw.owner = daemon
         # ECInject write type 2: the primary marks ITSELF down via the
         # mon command when the final sub-write commit arrives
         # (ECBackend.cc:1158-1167). Async: osd_down propagates the map
@@ -517,13 +595,26 @@ class OSDDaemon:
         self.store = store if store is not None else MemStore(f"osd.{osd_id}")
         self.chunk_size = chunk_size
         self.op_timeout = op_timeout
+        from ceph_tpu.utils import config as _netcfg
+
         self.local = ShardBackend(_AnyShardStores(self.store))
-        self.peers = NetShardBackend({}, secret=secret)
+        self.peers = NetShardBackend(
+            {}, secret=secret, name=f"osd.{osd_id}",
+            timeout=_netcfg.get("osd_peer_rpc_timeout"),
+        )
         #: coalescing observability + the sub-write frame-packing hook
         self.coalesce_pc = _coalesce_perf(f"osd.{osd_id}.coalesce")
         #: peering observability (elections, rewinds, fence rejects,
         #: state dwell times) — shared by the FSM and legacy paths
         self.peering_pc = make_peering_perf(f"osd.{osd_id}.peering")
+        #: net-fault observability: both of this daemon's messengers
+        #: (serving + peer-client) report into the ONE osd.<id>.net
+        #: set, so a link's faults land on the daemon that owns the
+        #: faulted endpoint
+        self.net_pc = make_net_perf(f"osd.{osd_id}.net")
+        self.peers.messenger.net_pc = self.net_pc
+        #: crash-replay observability (rollbacks/rollforwards)
+        self.rmw_crash_pc = make_rmw_crash_perf(f"osd.{osd_id}.rmw_crash")
         self.peers.on_subwrite_batch = self._on_subwrite_batch
         # stamp my map interval into every sub-write (replica fence)
         self.peers.interval_fn = lambda: (
@@ -537,6 +628,7 @@ class OSDDaemon:
         self._fence_epochs: dict[tuple[int, int], int] = {}
         self.osdmap: OSDMap = monitor.osdmap
         self.messenger = Messenger(f"osd.{osd_id}", secret=secret)
+        self.messenger.net_pc = self.net_pc
         self.messenger.set_dispatcher(self._dispatch)
         self.addr: tuple[str, int] | None = None
         self._pgs: dict[tuple[str, int], _PG] = {}
@@ -736,10 +828,18 @@ class OSDDaemon:
         """QoS admission gate for background work: blocks until the
         scheduler grants a slot. Times out permissively (work proceeds
         unthrottled rather than deadlocking when the worker is stuck
-        behind a lock the caller holds)."""
+        behind a lock the caller holds). A STOPPED daemon grants
+        immediately — its worker is gone, and a lingering background
+        sweep (scheduled scrub over a corpse) must not crawl at one
+        object per timeout."""
+        if self._stopped:
+            return
         ev = threading.Event()
         self._schedule(class_name, ev.set, cost)
-        ev.wait(timeout=self.op_timeout)
+        deadline = time.monotonic() + self.op_timeout
+        while not ev.wait(timeout=0.5):
+            if self._stopped or time.monotonic() >= deadline:
+                return
 
     def _tick_loop(self) -> None:
         while not self._tick_stop.wait(self.tick_period):
@@ -1155,7 +1255,13 @@ class OSDDaemon:
             for _ in range(8):
                 self.admit("recovery")
                 with push_lock:
-                    pg.recovery.recover_from_log(pg.pglog, shard)
+                    replayed = pg.recovery.recover_from_log(
+                        pg.pglog, shard
+                    )
+                if replayed:
+                    self.rmw_crash_pc.inc(
+                        "rollforwards", len(replayed)
+                    )
                 if not _dirty():
                     break
             # Eversion divergence pass: log replay brings the member
@@ -1180,6 +1286,7 @@ class OSDDaemon:
                 )
                 with push_lock:
                     pg.recovery.recover_object(loc, {shard})
+                self.rmw_crash_pc.inc("rollbacks")
             for loc in sorted(divergent_deletes):
                 self.log.info(
                     "pg", f"{pg.pool}/{pg.pgid}:", "divergent create",
@@ -1187,6 +1294,7 @@ class OSDDaemon:
                 )
                 with push_lock:
                     self._push_delete(target_osd, loc, shard)
+                self.rmw_crash_pc.inc("divergent_removes")
             # Admission happens under the op lock with a final clean
             # check: client writes (which also take _op_lock) cannot
             # append dirty entries between the check and the admit, so
@@ -1896,17 +2004,25 @@ class OSDDaemon:
                 # joins the worker/messenger threads this may run on.
                 threading.Thread(target=self.stop, daemon=True).start()
                 return
+            def _applied_ack() -> None:
+                # crash point: the txn is durable in this member's
+                # store, the ack not yet on the wire — a kill here is
+                # the half-committed sub-write (the sender parks; on
+                # restart the pg log rolls this member forward or the
+                # election rolls its divergence back)
+                crash_points.fire(
+                    "rmw.subwrite_applied_before_ack", daemon=self,
+                    tid=msg.tid, shard=msg.shard,
+                )
+                conn.send(ECSubWriteReply(msg.tid, msg.shard))
+
             with tracer.continue_trace(msg.trace_id, msg.parent_span):
                 with tracer.span(
                     "sub_write", osd=self.osd_id, shard=msg.shard,
                     tid=msg.tid,
                 ):
                     self.local.submit_shard_txn(
-                        self.osd_id,
-                        msg.txn,
-                        lambda: conn.send(
-                            ECSubWriteReply(msg.tid, msg.shard)
-                        ),
+                        self.osd_id, msg.txn, _applied_ack
                     )
         elif isinstance(msg, ECSubWriteBatch):
             self._handle_sub_write_batch(conn, msg)
@@ -1972,6 +2088,13 @@ class OSDDaemon:
                     self.osd_id, txn, lambda a=acked: a.append(True)
                 )
             if acked:
+                # same applied-but-unacked crash class as the solo
+                # path: everything up to here is durable, this item's
+                # ack (and its batch-mates') may never leave
+                crash_points.fire(
+                    "rmw.subwrite_applied_before_ack", daemon=self,
+                    tid=tid, shard=shard,
+                )
                 results.append((tid, True))
         conn.send(ECSubWriteBatchReply(msg.tid, self.osd_id, results))
 
@@ -2059,6 +2182,17 @@ class OSDDaemon:
             )
             reply = OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio", data=str(e).encode()
+            )
+        if msg.op in _MUTATING_OPS and not reply.error:
+            # crash point: the mutation is committed cluster-wide, the
+            # client reply not yet sent — a kill here forces the
+            # client's ambiguous resend, which MUST dedup through the
+            # replicated reqid window on the takeover primary (outside
+            # the try above: an armed abort must lose the reply like
+            # the crash it models, never morph into an eio answer)
+            crash_points.fire(
+                "rmw.primary_committed_before_reply", daemon=self,
+                tid=msg.tid, op=msg.op,
             )
         conn.send(reply)
 
@@ -2417,6 +2551,8 @@ class OSDDaemon:
                 msg, OSDOpReply(msg.tid, ctx.epoch, size=ctx.size)
             )
         if kind == "eio":
+            if self._transient_degraded(pg, detail or ""):
+                return OSDOpReply(msg.tid, ctx.epoch, error="eagain")
             return self._record_completed(
                 msg, OSDOpReply(msg.tid, ctx.epoch, error="eio",
                                 data=(detail or "").encode())
@@ -2443,6 +2579,7 @@ class OSDDaemon:
         if msg.op in _MUTATING_OPS and msg.reqid:
             cached = self._completed_ops.get(msg.reqid)
             if cached is not None:
+                self.net_pc.inc("dedup_hits")
                 return OSDOpReply(
                     msg.tid, epoch, error=cached.error,
                     size=cached.size, data=cached.data,
@@ -2487,6 +2624,7 @@ class OSDDaemon:
                 if verdict == "durable":
                     if unv:
                         unv.discard(msg.reqid)
+                    self.net_pc.inc("dedup_hits")
                     return OSDOpReply(msg.tid, epoch, size=hit[1]), None
                 if verdict == "unknown":
                     # unreachable members could still prove the
@@ -2538,10 +2676,31 @@ class OSDDaemon:
             self._maybe_cow(pg, spec, msg.oid)
         return None, pg
 
+    def _transient_degraded(self, pg: _PG, err) -> bool:
+        """True when a below-min-size abort is a TRANSIENT local view
+        (lossy-link down-marks on members the map still calls up —
+        the recheck probe clears them within a tick): the op should
+        answer eagain for the client's resend ladder, not a terminal
+        eio. A genuinely under-replicated PG (map-level holes below
+        k) keeps the fast eio."""
+        text = str(err)
+        if (
+            "shards available" not in text
+            and "cannot decode" not in text
+            and "interval changed" not in text
+        ):
+            return False
+        acting = self.osdmap.pg_to_up_acting(pg.pool, pg.pgid)
+        live = sum(1 for o in acting if o != SHARD_NONE)
+        return live >= pg.sinfo.k
+
     def _record_completed(self, msg: OSDOp, reply: OSDOpReply) -> OSDOpReply:
         """Remember a mutation's outcome under its client reqid so a
         resend (lost reply) replays the result instead of re-applying.
-        Caller holds _op_lock."""
+        Caller holds _op_lock. eagain is never recorded — it is an
+        invitation to retry, and a cached one would replay forever."""
+        if reply.error == "eagain":
+            return reply
         if msg.reqid:
             self._completed_ops[msg.reqid] = reply
             while len(self._completed_ops) > self._completed_cap:
@@ -2962,6 +3121,12 @@ class OSDDaemon:
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
         if op.error is not None:
+            if self._transient_degraded(pg, op.error):
+                # lossy-link transient (map still healthy): the
+                # client's resend ladder retries past it
+                return OSDOpReply(
+                    msg.tid, self.osdmap.epoch, error="eagain"
+                )
             return OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
@@ -2989,6 +3154,12 @@ class OSDDaemon:
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
         if op.error is not None:
+            if self._transient_degraded(pg, op.error):
+                # lossy-link transient (map still healthy): the
+                # client's resend ladder retries past it
+                return OSDOpReply(
+                    msg.tid, self.osdmap.epoch, error="eagain"
+                )
             return OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
@@ -3011,6 +3182,12 @@ class OSDDaemon:
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
         if op.error is not None:
+            if self._transient_degraded(pg, op.error):
+                # lossy-link transient (map still healthy): the
+                # client's resend ladder retries past it
+                return OSDOpReply(
+                    msg.tid, self.osdmap.epoch, error="eagain"
+                )
             return OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
@@ -3027,6 +3204,12 @@ class OSDDaemon:
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
         if op.error is not None:
+            if self._transient_degraded(pg, op.error):
+                # lossy-link transient (map still healthy): the
+                # client's resend ladder retries past it
+                return OSDOpReply(
+                    msg.tid, self.osdmap.epoch, error="eagain"
+                )
             return OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
@@ -3368,6 +3551,12 @@ class OSDDaemon:
         pg.backend.drain_until(lambda: bool(done), timeout=self.op_timeout)
         op = done[0]
         if op.error is not None:
+            if self._transient_degraded(pg, op.error):
+                # lossy-link transient (map still healthy): the
+                # client's resend ladder retries past it
+                return OSDOpReply(
+                    msg.tid, self.osdmap.epoch, error="eagain"
+                )
             return OSDOpReply(
                 msg.tid, self.osdmap.epoch, error="eio",
                 data=str(op.error).encode(),
@@ -3527,6 +3716,14 @@ class OSDDaemon:
         self._maybe_gc_pools()
         self._maybe_schedule_scrubs()
         self._gc_dropped_snaps()
+        # lossy-link hygiene: a peer down-marked by a single lost ack
+        # (RPC expiry under the injected fault plane, or any transient
+        # stall) is re-probed while the map still says it's up; a Pong
+        # that postdates the mark clears it. Real failures never pong,
+        # so their marks stand until the map changes.
+        self.peers.recheck_down(
+            {o for o in self.peers.down_shards if self.osdmap.is_up(o)}
+        )
         # a failed peering pass leaves the gate closed; retry here
         with self._pg_lock:
             stuck = [
@@ -3565,6 +3762,22 @@ class OSDDaemon:
                         pg.acting[i] = osd
                         pg.backend.acting[i] = osd
                         pg.backend.recovering.add(i)
+                        to_heal.append((pg, i))
+                # lossy-link quarantine drain: a position parked in
+                # ``recovering`` by avail_shards (locally down-marked
+                # while the map said up) re-enters through catch-up
+                # once the peer answers pings again — the replay
+                # brings it the writes hole-journaled past it, and
+                # only the admission returns it to the read set
+                for i, osd in enumerate(pg.acting):
+                    if (
+                        osd != SHARD_NONE
+                        and osd != self.osd_id
+                        and i in pg.backend.recovering
+                        and i not in pg._catchup_inflight
+                        and self.osdmap.is_up(osd)
+                        and osd not in self.peers.down_shards
+                    ):
                         to_heal.append((pg, i))
         for pg, shard in to_heal:
             self.log.info(

@@ -170,124 +170,15 @@ def make_peering_perf(name: str):
 
 
 # -- crash-point fault injection -----------------------------------------
-class CrashPointAbort(Exception):
-    """Raised at an armed crash point to unwind the transition (the
-    ``fail`` and ``kill`` actions); the FSM parks in ``incomplete``
-    and the tick retries."""
-
-
-class ArmedPoint:
-    """One armed crash point. ``pause`` blocks the firing thread at
-    the point until :meth:`release` (tests synchronize on
-    :meth:`wait_hit`); ``fail`` raises :class:`CrashPointAbort`;
-    ``kill`` hard-stops the firing daemon (on a side thread — stop()
-    joins threads the point may be on) and then aborts the
-    transition; a callable runs with the fire context."""
-
-    def __init__(self, name, action, osd=None, pool=None, pgid=None,
-                 count=1, pause_cap=30.0) -> None:
-        if action not in ("pause", "fail", "kill") and not callable(action):
-            raise ValueError(f"unknown crash action {action!r}")
-        self.name = name
-        self.action = action
-        self.osd = osd
-        self.pool = pool
-        self.pgid = pgid
-        self.remaining = count  # None = unlimited until cleared
-        self.pause_cap = pause_cap
-        self.hits = 0
-        self._hit = threading.Event()
-        self._released = threading.Event()
-
-    def matches(self, name, daemon, pg) -> bool:
-        if name != self.name:
-            return False
-        if self.osd is not None and (
-            daemon is None or daemon.osd_id != self.osd
-        ):
-            return False
-        if self.pool is not None and (
-            pg is None or pg.pool != self.pool
-        ):
-            return False
-        if self.pgid is not None and (
-            pg is None or pg.pgid != self.pgid
-        ):
-            return False
-        return True
-
-    def wait_hit(self, timeout: float = 10.0) -> bool:
-        return self._hit.wait(timeout)
-
-    def release(self) -> None:
-        self._released.set()
-
-    def _fire(self, daemon, pg, ctx) -> None:
-        self.hits += 1
-        self._hit.set()
-        if self.action == "pause":
-            # capped: an un-released point must not wedge the FSM
-            # forever if a test dies before release()
-            self._released.wait(self.pause_cap)
-            return
-        if self.action == "fail":
-            raise CrashPointAbort(self.name)
-        if self.action == "kill":
-            if daemon is not None:
-                threading.Thread(
-                    target=daemon.stop, daemon=True,
-                    name=f"crash-kill-osd.{daemon.osd_id}",
-                ).start()
-            raise CrashPointAbort(self.name)
-        self.action(daemon=daemon, pg=pg, **ctx)
-
-
-class CrashPointRegistry:
-    """Process-global registry of named yield points inside peering
-    transitions. ``fire()`` is a single attribute check when nothing
-    is armed — the instrumentation costs nothing in production."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._armed: list[ArmedPoint] = []
-
-    def arm(
-        self, name: str, action="pause", *, osd=None, pool=None,
-        pgid=None, count=1, pause_cap: float = 30.0,
-    ) -> ArmedPoint:
-        pt = ArmedPoint(
-            name, action, osd=osd, pool=pool, pgid=pgid, count=count,
-            pause_cap=pause_cap,
-        )
-        with self._lock:
-            self._armed.append(pt)
-        return pt
-
-    def clear(self) -> None:
-        with self._lock:
-            for pt in self._armed:
-                pt.release()  # free any thread parked at a pause
-            self._armed.clear()
-
-    def fire(self, name: str, daemon=None, pg=None, **ctx) -> None:
-        if not self._armed:  # the hot-path fast exit
-            return
-        with self._lock:
-            pt = next(
-                (p for p in self._armed if p.matches(name, daemon, pg)),
-                None,
-            )
-            if pt is None:
-                return
-            if pt.remaining is not None:
-                pt.remaining -= 1
-                if pt.remaining <= 0:
-                    self._armed.remove(pt)
-        pt._fire(daemon, pg, ctx)  # outside the lock: it may block
-
-
-#: the process-global crash-point registry tests arm
-crash_points = CrashPointRegistry()
+# The registry moved to the neutral utils layer (round 13) so the RMW
+# pipeline fires points too without a pipeline -> cluster import; the
+# peering surface re-exports it unchanged (same singleton object).
+from ceph_tpu.utils.crash_points import (  # noqa: F401  (re-export)
+    ArmedPoint,
+    CrashPointAbort,
+    CrashPointRegistry,
+    crash_points,
+)
 
 
 # -- the per-PG state machine --------------------------------------------
@@ -713,6 +604,7 @@ class PgPeeringFsm:
                 Transaction().touch(key).remove(key)
             )
             pg.rmw.forget_object(loc)
+            d.rmw_crash_pc.inc("divergent_removes")
         def _adopt_req_window(loc: str) -> None:
             # my shard's reqid-dedup attr must advance to the
             # AUTHORITY's window alongside the rebuilt bytes: my own
@@ -742,6 +634,7 @@ class PgPeeringFsm:
             _reprime(loc)
             pg.recovery.recover_object(loc, {my_pos})
             _adopt_req_window(loc)
+            d.rmw_crash_pc.inc("rollbacks")
         for loc in missing:
             try:
                 _reprime(loc)
@@ -750,6 +643,7 @@ class PgPeeringFsm:
                     loc, {my_pos}, size=size if size > 0 else None
                 )
                 _adopt_req_window(loc)
+                d.rmw_crash_pc.inc("rollforwards")
             except Exception as e:
                 # best-effort: the adopted prime serves it degraded;
                 # scrub / the next pass repairs the shard copy

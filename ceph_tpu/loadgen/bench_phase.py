@@ -58,39 +58,56 @@ def _leg(
     k: int = 4,
     m: int = 2,
     faults: bool = False,
+    net_flaky: bool = False,
     device_clock: bool = False,
     use_mesh: bool = False,
     mesh_devices: int | None = None,
     seed: int = 0xEC,
 ) -> dict:
-    cluster = LoadCluster(
-        n_osds=n_osds, k=k, m=m, pg_num=8, chunk_size=16384,
-        use_mesh=use_mesh, mesh_devices=mesh_devices,
-    )
-    try:
-        spec = WorkloadSpec(
-            mix=dict(_MIX),
-            object_size=256 * 1024,
-            max_objects=max_objects,
-            queue_depth=qd,
-            total_ops=total_ops,
-            warmup_ops=max(total_ops // 10, 8),
-            popularity="zipfian",
-            device_clock=device_clock,
-            seed=seed,
+    from ceph_tpu.utils import config as _cfg
+
+    overrides = {}
+    if net_flaky:
+        # lossy-link leg: lost frames must resolve via the sub-op
+        # retransmit ladder + a short RPC deadline, not 10 s parks
+        overrides = dict(
+            osd_peer_rpc_timeout=1.0, osd_subop_resend_interval=0.2,
         )
-        schedule = None
-        if faults:
-            schedule = FaultSchedule(
-                [
-                    FaultEvent(at_op=total_ops // 3, action="kill"),
-                    FaultEvent(at_op=(2 * total_ops) // 3,
-                               action="revive"),
-                ]
+    with _cfg.override(**overrides):
+        cluster = LoadCluster(
+            n_osds=n_osds, k=k, m=m, pg_num=8, chunk_size=16384,
+            use_mesh=use_mesh, mesh_devices=mesh_devices,
+        )
+        try:
+            spec = WorkloadSpec(
+                mix=dict(_MIX),
+                object_size=256 * 1024,
+                max_objects=max_objects,
+                queue_depth=qd,
+                total_ops=total_ops,
+                warmup_ops=max(total_ops // 10, 8),
+                popularity="zipfian",
+                device_clock=device_clock,
+                seed=seed,
             )
-        return run_spec(cluster, spec, schedule)
-    finally:
-        cluster.shutdown()
+            schedule = None
+            if faults:
+                schedule = FaultSchedule(
+                    [
+                        FaultEvent(at_op=total_ops // 3, action="kill"),
+                        FaultEvent(at_op=(2 * total_ops) // 3,
+                                   action="revive"),
+                    ]
+                )
+            elif net_flaky:
+                # degraded-link leg: the acceptance profile held for
+                # the MIDDLE half of the run (fire/settle offsets)
+                schedule = FaultSchedule.net_flaky(
+                    total_ops, seed=seed,
+                )
+            return run_spec(cluster, spec, schedule)
+        finally:
+            cluster.shutdown()
 
 
 def measure_cluster(result: dict, enc_gbps: float) -> None:
@@ -133,6 +150,22 @@ def measure_cluster(result: dict, enc_gbps: float) -> None:
         # Python-socket-tier number doesn't round to zero)
         result["cluster_vs_kernel_frac"] = round(
             report["gbps"] / enc_gbps, 8
+        )
+
+    # -- degraded-link row: the same workload under the seeded
+    # net_flaky acceptance profile (>=2% drop + dup + ~50 ms p95
+    # delay on every inter-OSD link for the middle half of the run)
+    # — what the serving tier returns when the FABRIC, not a member,
+    # is the fault (arxiv 1906.08602's degraded-mode thesis)
+    flaky = _leg(total_ops, qd, max_objects, net_flaky=True)
+    result["cluster_degraded_link_gbps"] = flaky["gbps"]
+    result["cluster_degraded_link_iops"] = flaky["iops"]
+    result["cluster_degraded_link_verify_failures"] = (
+        flaky["verify_failures"]
+    )
+    if report["gbps"]:
+        result["cluster_degraded_link_frac"] = round(
+            flaky["gbps"] / report["gbps"], 6
         )
 
     # -- A/B: the same workload with coalescing OFF, in the same run

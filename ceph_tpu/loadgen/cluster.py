@@ -137,6 +137,9 @@ class LoadCluster:
         )
         self.io = self.client.open_ioctx(pool)
         self.dead: list[int] = []
+        #: OSDs currently cut off by a net partition (alive but
+        #: unreachable on the data plane; map-down once evidence lands)
+        self.partitioned: list[int] = []
 
     # -- thrasher controls ---------------------------------------------
     def live_osds(self) -> list[int]:
@@ -189,6 +192,67 @@ class LoadCluster:
         d.start()
         self.daemons[osd] = d
         self.dead.remove(osd)
+
+    # -- network-fault controls (the tc/netem analog) ------------------
+    def net_flaky(
+        self,
+        seed: int = 0xEC,
+        drop: float = 0.02,
+        dup: float = 0.02,
+        delay_ms: float = 5.0,
+        delay_jitter_ms: float = 47.0,
+        reorder: float = 0.01,
+        scope: str = "osd",
+    ) -> None:
+        """Arm a seeded flaky profile on every link: inter-OSD only
+        (``scope="osd"``, the acceptance profile) or the client legs
+        too (``scope="all"``). Deterministic per link from ``seed``."""
+        from ceph_tpu.msg.messenger import LinkRule, net_faults
+
+        rule = LinkRule(
+            drop=drop, dup=dup, delay_ms=delay_ms,
+            delay_jitter_ms=delay_jitter_ms, reorder=reorder,
+        )
+        net_faults.configure(seed)
+        if scope == "all":
+            net_faults.add_rule("*", "*", rule)
+        else:
+            net_faults.add_rule("osd.*", "osd.*", rule)
+
+    def net_partition(
+        self, osd: int, asymmetric: bool = False, seed: int = 0xEC,
+    ) -> None:
+        """Cut osd.<id> off the data plane (frames dropped; TCP stays
+        up, exactly a switch eating packets). ``asymmetric`` cuts only
+        the inbound half — the victim keeps sending into the void, the
+        re-election torture case. Failure detection is collapsed to a
+        command like ``kill()``'s: the mon marks the victim down (its
+        peers' evidence), so peering re-elects deterministically."""
+        from ceph_tpu.msg.messenger import net_faults
+
+        if not net_faults.active:
+            net_faults.configure(seed)
+        net_faults.partition(f"osd.{osd}", asymmetric=asymmetric)
+        if osd not in self.partitioned:
+            self.partitioned.append(osd)
+        self.mon.osd_down(osd)
+
+    def net_heal(self) -> None:
+        """Merge: clear every armed link rule (held/delayed frames
+        flush) and re-announce surviving partitioned daemons to the
+        mon (the MOSDBoot a real OSD sends when its links return).
+        Peering then re-admits them; scrub_clean is the caller's
+        convergence gate."""
+        from ceph_tpu.msg.messenger import net_faults
+
+        net_faults.clear()
+        for osd in list(self.partitioned):
+            self.partitioned.remove(osd)
+            if osd in self.dead:
+                continue  # killed while partitioned: revive's problem
+            d = self.daemons[osd]
+            if d.addr is not None:
+                self.mon.osd_boot(osd, d.addr)
 
     # -- recovery observation ------------------------------------------
     def is_recovered(self) -> bool:
@@ -273,6 +337,11 @@ class LoadCluster:
         return mesh_dispatch.get_dcn() is self.dcn and self.dcn is not None
 
     def shutdown(self) -> None:
+        from ceph_tpu.msg.messenger import net_faults
+
+        if self.partitioned or net_faults.active:
+            net_faults.clear()
+            self.partitioned.clear()
         self.client.shutdown()
         for d in self.daemons.values():
             d.stop()

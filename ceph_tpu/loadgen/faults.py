@@ -23,27 +23,41 @@ from dataclasses import dataclass, field
 VICTIM_PICKERS = ("least_primary", "most_primary")
 
 
+#: fault actions that drive the network-fault plane rather than
+#: process lifecycle; ``profile`` carries their parameters
+NET_ACTIONS = ("net_flaky", "net_partition", "net_clear")
+
+
 @dataclass
 class FaultEvent:
     #: fire once the run's completed-op counter reaches this
     at_op: int
     #: "kill" | "revive" | "dcn_kill" (hard-kill a DCN host process
     #: mid-run — the multi-chip msgr fault; ``osd`` carries the host
-    #: rank, default 1)
+    #: rank, default 1) | "net_flaky" (arm the seeded link-fault
+    #: profile in ``profile``) | "net_partition" (partition the
+    #: victim's links; ``osd``/picker chooses the victim) |
+    #: "net_clear" (clear the plane and heal partitions)
     action: str
     #: target: an osd id, a named victim picker ("least_primary" |
-    #: "most_primary", kill only, resolved at fire time), or None =
-    #: pick (kill: first live victim in id order for determinism;
-    #: revive: oldest corpse)
+    #: "most_primary"; kill and net_partition, resolved at fire
+    #: time), or None = pick (kill: first live victim in id order for
+    #: determinism; revive: oldest corpse)
     osd: int | str | None = None
+    #: net_flaky: {seed, drop, dup, delay_ms, delay_jitter_ms,
+    #: reorder, scope}; net_partition: {asymmetric}
+    profile: dict = field(default_factory=dict)
 
     def __post_init__(self) -> None:
-        if self.action not in ("kill", "revive", "dcn_kill"):
+        if self.action not in (
+            "kill", "revive", "dcn_kill", *NET_ACTIONS
+        ):
             raise ValueError(f"unknown fault action {self.action!r}")
         if isinstance(self.osd, str):
-            if self.action != "kill":
+            if self.action not in ("kill", "net_partition"):
                 raise ValueError(
-                    f"named victim {self.osd!r} only targets kills"
+                    f"named victim {self.osd!r} only targets kills "
+                    "and partitions"
                 )
             if self.osd not in VICTIM_PICKERS:
                 raise ValueError(
@@ -67,6 +81,7 @@ class FaultSchedule:
         self.recovered_at: float | None = None
         self.dcn_killed_at: float | None = None
         self.killed: list[int] = []
+        self._net_armed = False
 
     def maybe_fire(self, ops_done: int, cluster) -> None:
         """Fire every event whose offset has been reached. Called on
@@ -86,6 +101,34 @@ class FaultSchedule:
         if ev.action == "dcn_kill":
             cluster.kill_dcn_host(1 if ev.osd is None else ev.osd)
             self.dcn_killed_at = time.monotonic()
+            return
+        if ev.action == "net_flaky":
+            cluster.net_flaky(**ev.profile)
+            self._net_armed = True
+            if self.kill_at is None:
+                # the degraded window opens at the first link fault
+                # (the degraded-link row is cut from it, like a kill's)
+                self.kill_at = time.monotonic()
+            return
+        if ev.action == "net_partition":
+            osd = ev.osd
+            if isinstance(osd, str):
+                osd = getattr(cluster, osd + "_osd")()
+            if osd is None:
+                live = sorted(cluster.live_osds())
+                if not live:
+                    return
+                osd = live[0]
+            cluster.net_partition(osd, **ev.profile)
+            self._net_armed = True
+            if self.kill_at is None:
+                self.kill_at = time.monotonic()
+            return
+        if ev.action == "net_clear":
+            cluster.net_heal()
+            self._net_armed = False
+            if self.revive_at is None:
+                self.revive_at = time.monotonic()
             return
         if ev.action == "kill":
             osd = ev.osd
@@ -112,8 +155,14 @@ class FaultSchedule:
             self.revive_at = time.monotonic()
 
     def settle(self, cluster) -> None:
-        """Post-run: revive anything still dead, then wait for the
-        cluster to report recovered, stamping ``recovered_at``."""
+        """Post-run: heal any armed link faults/partitions, revive
+        anything still dead, then wait for the cluster to report
+        recovered, stamping ``recovered_at``."""
+        if self._net_armed:
+            cluster.net_heal()
+            self._net_armed = False
+            if self.revive_at is None:
+                self.revive_at = time.monotonic()
         for osd in list(self.killed):
             cluster.revive(osd)
             self.killed.remove(osd)
@@ -137,6 +186,75 @@ class FaultSchedule:
                     osd="most_primary",
                 ),
                 FaultEvent(max((2 * total_ops) // 3, 2), "revive"),
+            ],
+            recovery_timeout=recovery_timeout,
+        )
+
+    @classmethod
+    def net_flaky(
+        cls,
+        total_ops: int,
+        seed: int = 0xEC,
+        drop: float = 0.02,
+        dup: float = 0.02,
+        delay_ms: float = 5.0,
+        delay_jitter_ms: float = 47.0,
+        reorder: float = 0.01,
+        scope: str = "osd",
+        fire_frac: float = 0.25,
+        settle_frac: float = 0.75,
+        recovery_timeout: float = 60.0,
+    ) -> "FaultSchedule":
+        """The lossy-link soak schedule: arm a seeded flaky profile on
+        every link in ``scope`` ("osd" = inter-OSD only, "all" = the
+        client legs too) a quarter of the way in, clear it at three
+        quarters (the fire/settle offsets), and demand recovery at
+        settle. Defaults are the acceptance profile: >= 2% drop +
+        duplication + ~50 ms p95 delay, deterministic from ``seed``."""
+        return cls(
+            [
+                FaultEvent(
+                    max(int(total_ops * fire_frac), 1), "net_flaky",
+                    profile=dict(
+                        seed=seed, drop=drop, dup=dup,
+                        delay_ms=delay_ms,
+                        delay_jitter_ms=delay_jitter_ms,
+                        reorder=reorder, scope=scope,
+                    ),
+                ),
+                FaultEvent(
+                    max(int(total_ops * settle_frac), 2), "net_clear"
+                ),
+            ],
+            recovery_timeout=recovery_timeout,
+        )
+
+    @classmethod
+    def net_partition(
+        cls,
+        total_ops: int,
+        victim: "int | str" = "most_primary",
+        asymmetric: bool = True,
+        seed: int = 0xEC,
+        fire_frac: float = 0.33,
+        settle_frac: float = 0.66,
+        recovery_timeout: float = 60.0,
+    ) -> "FaultSchedule":
+        """Partition the (default most-primary) victim's links a third
+        of the way in — asymmetric by default, the half-dead case that
+        forces re-election while the victim keeps talking into the
+        void — and merge at two thirds; settle demands the healed
+        cluster reports recovered (scrub-clean is the caller's gate)."""
+        return cls(
+            [
+                FaultEvent(
+                    max(int(total_ops * fire_frac), 1),
+                    "net_partition", osd=victim,
+                    profile=dict(asymmetric=asymmetric, seed=seed),
+                ),
+                FaultEvent(
+                    max(int(total_ops * settle_frac), 2), "net_clear"
+                ),
             ],
             recovery_timeout=recovery_timeout,
         )

@@ -151,10 +151,13 @@ class ShardServer:
 
 
 class _Pending:
-    __slots__ = ("shard", "oid", "on_reply", "deadline", "is_read", "soft")
+    __slots__ = (
+        "shard", "oid", "on_reply", "deadline", "is_read", "soft",
+        "resend", "retry_at", "tries",
+    )
 
     def __init__(self, shard, oid, on_reply, deadline, is_read,
-                 soft=False):
+                 soft=False, resend=None, retry_at=None):
         self.shard = shard
         self.oid = oid
         self.on_reply = on_reply
@@ -164,6 +167,17 @@ class _Pending:
         #: grants): expiry wakes the waiter but must not mark the
         #: merely-busy peer down
         self.soft = soft
+        #: sub-op retransmit (the lossless-messenger replay collapsed
+        #: to idempotent re-send; armed only on lossy-link runs via
+        #: ``osd_subop_resend_interval``): re-fires the frame on a
+        #: doubling ladder until the reply lands or the deadline
+        #: expires. Safe because sub-writes carry absolute extents +
+        #: attrs (re-apply = same bytes), the interval fence rejects
+        #: cross-interval staleness, and a duplicate ack is absorbed
+        #: by the pending-entry pop exactly-once.
+        self.resend = resend
+        self.retry_at = retry_at
+        self.tries = 0
 
 
 class NetShardBackend:
@@ -179,14 +193,31 @@ class NetShardBackend:
         addrs: dict[int, tuple[str, int]],
         timeout: float = 10.0,
         secret: bytes | None = None,
+        name: str = "client",
     ) -> None:
         from ceph_tpu.utils.log import get_logger
 
+        from ceph_tpu.utils import config as _cfg
+
         self.addrs = dict(addrs)
         self.timeout = timeout
+        #: seconds before an un-replied sub-op is re-sent (0 = never,
+        #: the default: TCP is lossless, parked semantics stand).
+        #: Lossy-link runs (the injected fault plane) arm it so a lost
+        #: frame resolves in fractions of the RPC deadline.
+        self.resend_interval = float(
+            _cfg.get("osd_subop_resend_interval")
+        )
         self.down_shards: set[int] = set()
+        #: shard -> monotonic stamp of its LAST down-marking (the
+        #: recheck probe only clears a mark once liveness evidence —
+        #: a Pong — postdates it)
+        self._down_at: dict[int, float] = {}
         self._log = get_logger("msgr")
-        self.messenger = Messenger("client", secret=secret)
+        # ``name`` identifies this endpoint on the fault plane's link
+        # rules (an OSD daemon passes its own name so inter-OSD links
+        # read as osd.i -> osd.j, not client -> osd.j)
+        self.messenger = Messenger(name, secret=secret)
         self.messenger.set_dispatcher(self._dispatch)
         self._conns: dict[int, Connection] = {}
         self._tids = itertools.count(1)
@@ -248,6 +279,8 @@ class NetShardBackend:
                             ECSubWriteReply(t, msg.shard, c)
                         )
                     )
+                else:
+                    self._absorbed()
             return
         if not isinstance(
             msg,
@@ -259,17 +292,32 @@ class NetShardBackend:
             entry = self._waiting.pop((msg.tid, msg.shard), None)
         if entry is not None:
             self._inbox.put(lambda: entry.on_reply(msg))
+        elif isinstance(msg, (ECSubWriteReply, ECSubWriteBatchReply)):
+            self._absorbed()
+
+    def _absorbed(self) -> None:
+        """A write ack with no pending entry: a duplicated frame's
+        second copy, or a straggler ack that outlived its RPC deadline
+        — either way the commit path already consumed (or re-sent) the
+        op, so the ack is absorbed exactly-once. Observable on the
+        owning daemon's ``osd.N.net`` counter set."""
+        pc = self.messenger.net_pc
+        if pc is not None:
+            pc.inc("resends_absorbed")
 
     def _register(
         self, tid, shard, oid, on_reply, is_read,
-        deadline=None, soft=False,
+        deadline=None, soft=False, resend=None,
     ) -> None:
+        retry_at = None
+        if resend is not None and self.resend_interval > 0:
+            retry_at = time.monotonic() + self.resend_interval
         with self._lock:
             self._waiting[(tid, shard)] = _Pending(
                 shard, oid, on_reply,
                 deadline if deadline is not None
                 else time.monotonic() + self.timeout,
-                is_read, soft,
+                is_read, soft, resend=resend, retry_at=retry_at,
             )
 
     def _send(self, shard: int, msg, tid: int) -> bool:
@@ -279,28 +327,71 @@ class NetShardBackend:
         except (ConnectionError, OSError, KeyError):
             with self._lock:
                 self._waiting.pop((tid, shard), None)
-            if shard not in self.down_shards:
-                self._log.info("shard", shard, "marked down (send failed)")
-            self.down_shards.add(shard)
+            self._mark_down(shard, "send failed")
             return False
+
+    def _mark_down(self, shard: int, why: str) -> None:
+        if shard not in self.down_shards:
+            self._log.info("shard", shard, f"marked down ({why})")
+        self.down_shards.add(shard)
+        self._down_at[shard] = time.monotonic()
+
+    def recheck_down(self, shards=None) -> None:
+        """Re-probe locally down-marked peers (callers pass only ones
+        the OSDMap still says are up): a mark earned on a LOSSY link
+        — one lost ack tripping the RPC deadline — must not exclude a
+        healthy peer until the next map change. Evidence-based: a
+        Pong that postdates the down-mark clears it; otherwise a
+        fresh Ping goes out and a later recheck consumes its Pong. A
+        genuinely dead or partitioned peer never pongs, so its mark
+        stands (one-way marking is preserved for real failures)."""
+        now = time.monotonic()
+        for shard in list(self.down_shards):
+            if shards is not None and shard not in shards:
+                continue
+            if self._last_seen.get(shard, 0.0) > self._down_at.get(
+                shard, now
+            ):
+                self.down_shards.discard(shard)
+                self._down_at.pop(shard, None)
+                self._log.info(
+                    "shard", shard, "back up (pong after down-mark)"
+                )
+                continue
+            try:
+                self._conn(shard).send(Ping(next(self._tids), shard))
+            except (ConnectionError, OSError, KeyError):
+                pass
 
     def _expire(self) -> None:
         """Timed-out RPCs: mark the shard down; reads get an error
-        callback, writes stay parked (lost-ack semantics)."""
+        callback, writes stay parked (lost-ack semantics). Before the
+        deadline, entries with a retransmit ladder re-fire on their
+        doubling schedule (lossy-link runs only; see _Pending)."""
         now = time.monotonic()
         expired = []
+        resends = []
         with self._lock:
             for key, entry in list(self._waiting.items()):
                 if entry.deadline <= now:
                     expired.append((key, entry))
                     del self._waiting[key]
+                elif (
+                    entry.retry_at is not None and entry.retry_at <= now
+                ):
+                    entry.tries += 1
+                    entry.retry_at = now + self.resend_interval * (
+                        2 ** entry.tries
+                    )
+                    resends.append(entry.resend)
+        for fire in resends:  # outside the lock: sends can block
+            try:
+                fire()
+            except (ConnectionError, OSError, KeyError):
+                pass  # dead link: the deadline path judges it
         for (tid, shard), entry in expired:
             if not entry.soft:
-                if shard not in self.down_shards:
-                    self._log.info(
-                        "shard", shard, "marked down (rpc timeout)"
-                    )
-                self.down_shards.add(shard)
+                self._mark_down(shard, "rpc timeout")
             if entry.is_read:
                 from ceph_tpu.pipeline.read import ShardReadError
 
@@ -358,6 +449,7 @@ class NetShardBackend:
             conn.close()
         self._last_seen[shard] = time.monotonic()
         self.down_shards.discard(shard)
+        self._down_at.pop(shard, None)
 
     def avail_shards(self) -> set[int]:
         return set(self.addrs) - self.down_shards
@@ -382,11 +474,14 @@ class NetShardBackend:
             else:
                 cb(shard, dict(zip(reply.offsets, reply.buffers)))
 
-        self._register(tid, shard, oid, on_reply, is_read=True)
         t_id, t_span = tracer.current()
         msg = ECSubRead(
             tid, shard, oid, [(s, e) for s, e in extents], logical=logical,
             trace_id=t_id, parent_span=t_span,
+        )
+        self._register(
+            tid, shard, oid, on_reply, is_read=True,
+            resend=lambda: self._conn(shard).send(msg),
         )
         if not self._send(shard, msg, tid):
             self._inbox.put(lambda: cb(shard, ShardReadError(shard, oid)))
@@ -604,11 +699,7 @@ class NetShardBackend:
                 with self._lock:
                     for tid, *_rest in items:
                         self._waiting.pop((tid, shard), None)
-                if shard not in self.down_shards:
-                    self._log.info(
-                        "shard", shard, "marked down (send failed)"
-                    )
-                self.down_shards.add(shard)
+                self._mark_down(shard, "send failed")
 
     def submit_shard_txn(
         self, shard: int, txn: Transaction, ack: Callable[[], None]
@@ -620,9 +711,20 @@ class NetShardBackend:
                 ack()
             # else parked: ack never fires, recovery's problem
 
-        self._register(tid, shard, "", on_reply, is_read=False)
         epoch, from_osd = (
             self.interval_fn() if self.interval_fn else (0, -1)
+        )
+        t_id, t_span = tracer.current()
+        msg = ECSubWrite(
+            tid, shard, txn, trace_id=t_id, parent_span=t_span,
+            epoch=epoch, from_osd=from_osd,
+        )
+        # retransmits always go out SOLO (even for batch-staged
+        # items): the receiver path is identical and the frame is
+        # self-contained
+        self._register(
+            tid, shard, "", on_reply, is_read=False,
+            resend=lambda: self._conn(shard).send(msg),
         )
         with self._lock:
             if self._stage_depth > 0:
@@ -630,15 +732,7 @@ class NetShardBackend:
                     (tid, epoch, from_osd, txn)
                 )
                 return
-        t_id, t_span = tracer.current()
-        self._send(
-            shard,
-            ECSubWrite(
-                tid, shard, txn, trace_id=t_id, parent_span=t_span,
-                epoch=epoch, from_osd=from_osd,
-            ),
-            tid,
-        )
+        self._send(shard, msg, tid)
 
     # -- heartbeats (OSD::handle_osd_ping / stale-ping culling) --------
     def start_heartbeat(
@@ -666,11 +760,11 @@ class NetShardBackend:
                             Ping(next(self._tids), shard)
                         )
                     except (ConnectionError, OSError):
-                        self.down_shards.add(shard)
+                        self._mark_down(shard, "ping failed")
                         continue
                     age = time.monotonic() - self._last_seen.get(shard, 0)
                     if age > grace:
-                        self.down_shards.add(shard)
+                        self._mark_down(shard, "ping silence")
 
         self._hb_thread = threading.Thread(target=loop, daemon=True)
         self._hb_thread.start()

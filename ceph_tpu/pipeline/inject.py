@@ -8,6 +8,11 @@ exactly where the reference hooks ``handle_sub_read`` /
 - read type 0: sub-read fails with EIO.
 - read type 1: shard reports the object missing (ENOENT-alike) —
   exercises the same retry path with a different error class.
+- read type 2: SILENT corruption — the sub-read succeeds but the
+  returned shard payload has bytes flipped. Nothing errors at the
+  transport: only an integrity tier (BlockStore csums at rest, deep
+  scrub's HashInfo comparison, the client's content verify) can
+  catch it — the bit-rot-on-the-wire / buggy-drive-firmware case.
 - write type 0: the client write op fails before dispatch (abort).
 - write type 1: the sub-write to a shard is silently dropped — the ack
   never arrives, leaving the op parked in the in-order commit queue
@@ -83,7 +88,7 @@ class ECInject:
         self, oid: str, type: int, when: int = 0, duration: int = 1,
         shard: int = ANY_SHARD,
     ) -> str:
-        if type not in (0, 1):
+        if type not in (0, 1, 2):
             return "unrecognized error inject type"
         with self._lock:
             self._rules[("read", type, oid, shard)] = _Rule(when, duration)
@@ -144,6 +149,25 @@ class ECInject:
 
     def test_read_error1(self, oid: str, shard: int) -> bool:
         return self._test("read", 1, oid, shard)
+
+    def test_read_error2(self, oid: str, shard: int) -> bool:
+        """Silent corruption: the consult site flips bytes in the
+        payload it is about to return (no error surfaces here)."""
+        return self._test("read", 2, oid, shard)
+
+    @staticmethod
+    def corrupt(buf: bytes) -> bytes:
+        """The canonical payload mangling for read type 2: invert the
+        first byte (and one mid-buffer byte for runs long enough to
+        span csum blocks) — enough for any integrity check, invisible
+        to everything else."""
+        if not buf:
+            return buf
+        out = bytearray(buf)
+        out[0] ^= 0xFF
+        if len(out) > 4096:
+            out[4096] ^= 0xFF
+        return bytes(out)
 
     def test_write_error0(self, oid: str) -> bool:
         return self._test("write", 0, oid, ANY_SHARD)

@@ -37,6 +37,7 @@ import numpy as np
 
 from ceph_tpu.codecs.interface import Flag
 from ceph_tpu.store import Transaction
+from ceph_tpu.utils.crash_points import crash_points
 
 from .extent_cache import CacheOp, ECExtentCache
 from .extents import ExtentSet
@@ -288,6 +289,8 @@ class ShardBackend:
             run()
 
     def read_shard(self, shard: int, oid: str, extents: ExtentSet) -> dict[int, bytes]:
+        from .inject import ec_inject
+
         store = self.stores[shard]
         out = {}
         for start, end in extents:
@@ -297,6 +300,13 @@ class ShardBackend:
                 buf = b""
             buf = buf + b"\0" * (end - start - len(buf))  # zero-pad EOF
             out[start] = buf
+        if ec_inject.test_read_error2(oid, shard):
+            # ECInject read type 2: the payload leaves here silently
+            # corrupted — only an integrity tier may notice
+            out = {
+                start: ec_inject.corrupt(buf)
+                for start, buf in out.items()
+            }
         return out
 
     def submit_shard_txn(
@@ -390,6 +400,10 @@ class RMWPipeline:
         #: its "mark me down" mon command (ECBackend.cc:1158-1167);
         #: standalone pipelines leave it None
         self.on_osd_down_inject: Callable[[], None] | None = None
+        #: the owning OSD daemon (None for standalone pipelines) —
+        #: crash points fire with it so osd= filters and the ``kill``
+        #: action resolve; never otherwise consulted
+        self.owner = None
         #: serializes ack/commit bookkeeping: sub-write acks arrive on
         #: messenger pump threads while map changes release dead
         #: shards' acks from the monitor-notify thread — both mutate
@@ -418,16 +432,45 @@ class RMWPipeline:
         offsets from its last primacy's sizes and tore the log the
         interim primary had extended (round-5 kill/revive thrash
         find). The next op re-primes from the store's OI/HashInfo
-        attrs. Old-interval in-flight ops cannot re-poison the maps:
-        their sub-writes are interval-fenced at the members, so they
-        park and never reach the commit bookkeeping."""
+        attrs.
+
+        In-flight ops of the OLD interval are REQUEUED-as-errors (the
+        reference requeues them into the new interval and the client
+        resend dedups via reqid): their sub-writes are fenced at the
+        members — `committed=False`, no ack ever — so leaving them
+        parked wedges the per-object cache FIFO, and every new-interval
+        op on the object queues behind the corpse forever (the
+        kill × net_flaky composition found the wedge: a live,
+        re-elected primary kept its own fenced op parked, stalling the
+        coalesce drain for the whole worker). Completing them with the
+        retryable interval error releases the cache; the resend
+        re-runs them against the new interval's election."""
+        stale: list[ClientOp] = []
         with self._ack_lock:
             self._object_sizes.clear()
             self._projected_sizes.clear()
             self._eversions.clear()
             self._live_eversions.clear()
             self._hinfo.clear()
+            for op in self._inflight.values():
+                if not op.committed and op.written is not None:
+                    # dispatched (sub-writes on the wire, fenceable);
+                    # un-dispatched ops still ride the cache queue and
+                    # will dispatch -> fence -> ... so requeue them on
+                    # their dispatch instead: leave them be
+                    op.error = IOError(
+                        "interval changed - op requeued for resend"
+                    )
+                    op.committed = True
+                    self.perf.inc("aborts")
+                    stale.append(op)
             self.cache.on_change()
+        # cache release outside the lock (the write_done may cascade);
+        # a requeued op publishes an EMPTY map like any failed op
+        for op in stale:
+            self.cache.write_done(op.cache_op, ShardExtentMap(self.sinfo))
+        with self._ack_lock:
+            self._check_commit_order()
 
     # -- client entry (ECBackend::submit_transaction analog) -----------
     def submit(
@@ -939,6 +982,13 @@ class RMWPipeline:
                     op.extra_attrs,
                 ),
             )
+        # crash point: plan chosen, stripe encoded, pg log appended —
+        # nothing on the wire yet. A kill here loses the op entirely
+        # (no shard saw it); the client's resend re-runs it whole.
+        crash_points.fire(
+            "rmw.prepare_done", daemon=self.owner, oid=op.oid,
+            tid=op.tid,
+        )
         # build every txn before the first dispatch: a synchronous ack
         # (local stores) must see the complete written map
         for shard, txn in txns:
@@ -1004,6 +1054,15 @@ class RMWPipeline:
             op.pending_shards.discard(shard)
             op.acked_shards.add(shard)
             if not op.pending_shards and not op.committed:
+                # crash point: every sub-write durable on its shard,
+                # the commit decision not yet taken. A kill here is
+                # the fully-applied-but-unreported crash: replay must
+                # ROLL FORWARD (all shards agree) and the client's
+                # resend dedup via the replicated reqid window.
+                crash_points.fire(
+                    "rmw.primary_before_commit", daemon=self.owner,
+                    oid=op.oid, tid=op.tid,
+                )
                 op.committed = True
                 finish = True
         # cache release OUTSIDE the ack lock: write_done may dispatch

@@ -58,7 +58,9 @@ class TestRegistry:
         assert ec_inject.write_error("o", 4) == (
             "unrecognized error inject type"
         )
-        assert ec_inject.read_error("o", 2) == (
+        # read type 2 became the silent-corruption inject (ISSUE 9);
+        # the first still-unknown read type is 3
+        assert ec_inject.read_error("o", 3) == (
             "unrecognized error inject type"
         )
 
@@ -222,3 +224,181 @@ class TestDaemonTier:
         # the daemon itself is alive (marked down, not crashed): reads
         # proceed through the failover primary
         assert io.read("obj") == data
+
+
+class TestSilentCorruptionReadType:
+    """ECInject read type 2 (ISSUE 9 satellite): the sub-read SUCCEEDS
+    but returns flipped bytes — nothing errors at the transport; the
+    integrity tiers (BlockStore at-rest csums, deep scrub vs HashInfo,
+    client content verify) are what catch it."""
+
+    def test_type2_flips_returned_payload_silently(self, rng):
+        from ceph_tpu.pipeline.extents import ExtentSet
+
+        rmw, _rec, _log, sinfo, _codec, backend = make_stack()
+        data = _payload(2 * sinfo.stripe_width, seed=3)
+        done = []
+        rmw.submit("o", 0, data, on_commit=done.append)
+        assert done and done[0].error is None
+        clean = backend.read_shard(0, "o", ExtentSet([(0, CHUNK)]))
+        ec_inject.read_error("o", 2, shard=0)
+        bad = backend.read_shard(0, "o", ExtentSet([(0, CHUNK)]))
+        assert bad[0] != clean[0], "payload must be corrupted"
+        assert bad[0][0] == clean[0][0] ^ 0xFF
+        # rule consumed (duration 1): the next read is clean again
+        again = backend.read_shard(0, "o", ExtentSet([(0, CHUNK)]))
+        assert again[0] == clean[0]
+
+    def test_type2_corruption_is_silent_to_the_read_path(self, rng):
+        """The defining property: a corrupted sub-read does NOT error
+        — a plain read returns wrong bytes without complaint (only an
+        integrity tier can catch it; the daemon-tier scrub leg lives
+        in TestIntegrityLoopLive)."""
+        from ceph_tpu.pipeline.read import ReadPipeline
+
+        rmw, _rec, _log, sinfo, codec, backend = make_stack()
+        data = _payload(2 * sinfo.stripe_width, seed=4)
+        done = []
+        rmw.submit("o", 0, data, on_commit=done.append)
+        assert done and done[0].error is None
+        reads = ReadPipeline(
+            sinfo, codec, backend, rmw.object_size
+        )
+        assert reads.read_sync("o", 0, len(data)) == data
+        ec_inject.read_error("o", 2, shard=0, duration=1)
+        got = reads.read_sync("o", 0, len(data))  # no exception!
+        assert got != data, "corruption must pass silently"
+
+
+class TestIntegrityLoopLive:
+    """The full integrity loop, live on a BlockStore-backed cluster
+    (ISSUE 9 satellite): at-rest bit rot -> BlockStore checksum EIO ->
+    the read re-plans from the remaining survivors and still verifies
+    -> deep scrub auto-repairs -> scrub_history's repaired flag is
+    observable."""
+
+    def test_bit_rot_to_repair_loop(self, tmp_path):
+        from ceph_tpu.cluster.osd_daemon import make_loc, shard_key
+        from ceph_tpu.loadgen import LoadCluster
+        from ceph_tpu.store import BlockStore
+        from ceph_tpu.utils import config
+
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=4, chunk_size=4096,
+            tick_period=0.1,
+            store_factory=lambda i: BlockStore(
+                str(tmp_path / f"osd{i}"), size=1 << 22
+            ),
+        )
+        try:
+            io = cluster.io
+            data = _payload(3 * 2 * 4096, seed=9)
+            assert io.write_full("rot", data) == len(data)
+            assert io.read("rot") == data
+            # flip one device byte under shard 0's blob — BELOW the
+            # csum layer, the bit-rot case
+            acting = cluster.mon.osdmap.object_to_acting(
+                cluster.pool, "rot"
+            )
+            osd = acting[0]
+            store = cluster.stores[osd]
+            key = shard_key(
+                make_loc(
+                    cluster.mon.osdmap.pools[cluster.pool].pool_id,
+                    "rot",
+                ),
+                0,
+            )
+            blob = next(iter(store._objects[key].blobs.values()))
+            import os
+
+            with open(os.path.join(store.root, "block"), "r+b") as f:
+                f.seek(blob.offset + 17)
+                byte = f.read(1)
+                f.seek(blob.offset + 17)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            # 1) the CLIENT read still verifies: BlockStore answers
+            # EIO for the rotten shard, the read pipeline re-plans
+            # from the remaining survivors and decodes around it
+            assert io.read("rot") == data, (
+                "read must re-plan around the checksum EIO"
+            )
+            # 2) deep scrub auto-repairs the shard and the repaired
+            # flag lands in scrub_history (the observable)
+            pgid = cluster.mon.osdmap.object_to_pg(cluster.pool, "rot")
+            primary = cluster.mon.osdmap.pg_primary(cluster.pool, pgid)
+            d = cluster.daemons[primary]
+            with config.override(osd_scrub_auto_repair=True):
+                d._run_scheduled_scrub(cluster.pool, pgid, "deep")
+            stamp, kind, n_err, repaired = d.scrub_history[
+                (cluster.pool, pgid)
+            ]
+            assert kind == "deep"
+            assert n_err > 0, "scrub must have seen the rotten shard"
+            assert repaired, "auto-repair must have rebuilt it"
+            # 3) after repair the rotten shard serves clean bytes:
+            # a direct read of shard 0 round-trips through its store
+            assert io.read("rot") == data
+            (res,) = [
+                r
+                for r in d.scrub_pg(cluster.pool, pgid)
+                if r.oid == key.rsplit("#s", 1)[0]
+            ]
+            assert res.ok, "post-repair scrub must be clean"
+        finally:
+            cluster.shutdown()
+
+    def test_type2_read_corruption_caught_by_deep_scrub(self, tmp_path):
+        """The ECInject half of the satellite, live: a shard whose
+        sub-reads LIE (read type 2 — flipped payloads, no error) is
+        caught by the daemon deep scrub's HashInfo comparison and
+        flagged with a crc mismatch; clearing the lie, repair + a
+        clean rescrub close the loop."""
+        from ceph_tpu.cluster.osd_daemon import make_loc, shard_key
+        from ceph_tpu.loadgen import LoadCluster
+
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=4, chunk_size=4096,
+            tick_period=0.1,
+        )
+        try:
+            io = cluster.io
+            data = _payload(2 * 2 * 4096, seed=11)
+            assert io.write_full("liar", data) == len(data)
+            loc = make_loc(
+                cluster.mon.osdmap.pools[cluster.pool].pool_id, "liar"
+            )
+            # the daemon tier consults under the per-shard store key
+            ec_inject.read_error(
+                shard_key(loc, 1), 2, duration=1_000_000
+            )
+            # silent at the client: the read SUCCEEDS with wrong bytes
+            got = io.read("liar")
+            assert got != data
+            pgid = cluster.mon.osdmap.object_to_pg(
+                cluster.pool, "liar"
+            )
+            primary = cluster.mon.osdmap.pg_primary(cluster.pool, pgid)
+            d = cluster.daemons[primary]
+            results = [
+                r for r in d.scrub_pg(cluster.pool, pgid)
+                if r.oid == loc
+            ]
+            assert results and not results[0].ok, (
+                "deep scrub must catch the lying shard"
+            )
+            assert {e.shard for e in results[0].errors} == {1}
+            ec_inject.clear_read_error(shard_key(loc, 1), 2)
+            (res,) = [
+                r
+                for r in d.scrub_pg(cluster.pool, pgid, repair=True)
+                if r.oid == loc
+            ]
+            assert io.read("liar") == data
+            (res2,) = [
+                r for r in d.scrub_pg(cluster.pool, pgid)
+                if r.oid == loc
+            ]
+            assert res2.ok
+        finally:
+            cluster.shutdown()

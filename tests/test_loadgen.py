@@ -306,6 +306,25 @@ class TestCli:
         assert args.workload == "loadgen"
         assert args.fault_at == 5
 
+    def test_net_fault_flags_parse(self):
+        from ceph_tpu import bench_cli
+
+        args = bench_cli.parse_args([
+            "loadgen", "--smoke", "--net-fault", "flaky",
+            "--net-drop", "0.05", "--net-dup", "0.01",
+            "--net-delay-ms", "2",
+        ])
+        assert args.net_fault == "flaky"
+        assert args.net_drop == 0.05
+        args = bench_cli.parse_args(
+            ["loadgen", "--smoke", "--net-fault", "partition"]
+        )
+        assert args.net_fault == "partition"
+        with pytest.raises(SystemExit):
+            bench_cli.parse_args(
+                ["loadgen", "--net-fault", "bogus"]
+            )
+
     def test_bad_mix_rejected(self):
         with pytest.raises(ValueError):
             parse_mix("")

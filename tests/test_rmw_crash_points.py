@@ -1,0 +1,232 @@
+"""Crash-consistent RMW commit: kill-at-point -> restart -> replay at
+every named crash point in the commit path (ISSUE 9 tentpole, the
+crash_points registry grown from peering transitions into RMW).
+
+The four points cover the commit path's distinct crash classes:
+
+- ``rmw.prepare_done``              nothing on the wire (op lost whole)
+- ``rmw.subwrite_applied_before_ack``  one member durable, ack lost
+- ``rmw.primary_before_commit``     all members durable, commit unsaid
+- ``rmw.primary_committed_before_reply``  committed, reply lost
+
+Every test drives the same contract: the armed daemon hard-crashes at
+the point (data plane silenced atomically), the client's ambiguous
+resend walks the objecter ladder, the takeover/revival replays the
+pg log (rollback/rollforward counted on ``osd.N.rmw_crash``), and a
+committed read afterwards returns EXACTLY the committed bytes —
+scrub-clean, no torn stripe, no double-append.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.utils.crash_points import crash_points
+
+OLD = b"committed-old-" + bytes(range(200)) * 12
+NEW = b"committed-new-" + bytes(reversed(range(256))) * 9
+
+
+@pytest.fixture(autouse=True)
+def clean_points():
+    crash_points.clear()
+    yield
+    crash_points.clear()
+
+
+@pytest.fixture
+def cluster():
+    from ceph_tpu.loadgen import LoadCluster
+
+    c = LoadCluster(
+        n_osds=4, k=2, m=1, pg_num=4, chunk_size=1024,
+        tick_period=0.1, client_op_timeout=2.0,
+        client_max_attempts=12, client_backoff=0.05,
+    )
+    yield c
+    c.shutdown()
+
+
+def _acting(cluster, oid):
+    return cluster.mon.osdmap.object_to_acting(cluster.pool, oid)
+
+
+def _kill_at_point_and_converge(
+    cluster, point, victim_role, oid="crash-obj",
+):
+    """The shared kill->restart->replay drill. ``victim_role`` is
+    "primary" or "replica" (which daemon the point is armed on)."""
+    io = cluster.io
+    assert io.write_full(oid, OLD) == len(OLD)
+    acting = _acting(cluster, oid)
+    primary = acting[0]
+    victim = primary if victim_role == "primary" else acting[1]
+    pt = crash_points.arm(point, "kill", osd=victim)
+
+    comp = io.aio_write_full(oid, NEW)
+    assert pt.wait_hit(15), f"{point} never fired on osd.{victim}"
+    # collapse failure detection to a command (the kill() contract):
+    # the daemon is already dying from the crash point; the mon learns
+    cluster.kill(victim)
+    # the client's resend converges against the surviving/new primary
+    reply = comp.wait_for_complete(45)
+    assert reply.size == len(NEW)
+    # restart over the corpse's store: replay must converge the shard
+    cluster.revive(victim)
+    assert cluster.wait_recovered(45), "revive never converged"
+    got = io.read(oid)
+    assert got == NEW, (
+        f"committed read after {point} kill returned "
+        f"{len(got)}B != committed {len(NEW)}B "
+        f"(first diff at {next((i for i, (a, b) in enumerate(zip(got, NEW)) if a != b), min(len(got), len(NEW)))})"
+    )
+    assert cluster.scrub_clean(), "post-replay cluster not scrub-clean"
+    return victim
+
+
+class TestKillAtEveryPoint:
+    def test_prepare_done(self, cluster):
+        """Killed with the op planned+encoded but nothing on the wire:
+        the op is lost whole; the resend re-runs it from scratch."""
+        _kill_at_point_and_converge(
+            cluster, "rmw.prepare_done", "primary"
+        )
+
+    def test_subwrite_applied_before_ack(self, cluster):
+        """A replica dies AFTER applying the sub-write, BEFORE its ack:
+        the op commits on the survivors, and the revived member is
+        rolled FORWARD (or its divergence back) by log replay."""
+        victim = _kill_at_point_and_converge(
+            cluster, "rmw.subwrite_applied_before_ack", "replica"
+        )
+        # replay accounting is observable on the converging primaries
+        total = sum(
+            d.rmw_crash_pc.get("rollforwards")
+            + d.rmw_crash_pc.get("rollbacks")
+            for d in cluster.daemons.values()
+        )
+        assert total > 0, (
+            f"revival of osd.{victim} must have replayed the log"
+        )
+
+    def test_primary_before_commit(self, cluster):
+        """Every sub-write durable everywhere, the primary dies before
+        marking the op committed: the resent op must DEDUP through the
+        replicated reqid window (durable verdict -> replay), never
+        re-apply."""
+        _kill_at_point_and_converge(
+            cluster, "rmw.primary_before_commit", "primary"
+        )
+
+    def test_primary_committed_before_reply(self, cluster):
+        """Committed cluster-wide, the reply dies with the primary:
+        the ambiguous resend replays the recorded result."""
+        _kill_at_point_and_converge(
+            cluster, "rmw.primary_committed_before_reply", "primary"
+        )
+
+
+class TestCommittedBytesNeverDouble:
+    def test_append_resend_after_committed_kill_appends_once(
+        self, cluster
+    ):
+        """The sharpest dedup case: an APPEND whose primary dies
+        between commit and reply. The client's resend must replay the
+        recorded result — a re-apply would double the segment."""
+        io = cluster.io
+        oid = "crash-append"
+        io.write_full(oid, b"base|")
+        primary = _acting(cluster, oid)[0]
+        pt = crash_points.arm(
+            "rmw.primary_committed_before_reply", "kill", osd=primary
+        )
+        comp = io.objecter.submit_async(
+            cluster.pool, oid, "append", data=b"once|"
+        )
+        assert pt.wait_hit(15)
+        cluster.kill(primary)
+        reply = comp.wait_for_complete(45)
+        assert reply.size == len(b"base|once|")
+        cluster.revive(primary)
+        assert cluster.wait_recovered(45)
+        assert io.read(oid) == b"base|once|", "append must land once"
+        assert cluster.scrub_clean()
+
+
+class TestRegistryAndPoints:
+    def test_registry_lives_in_utils_and_peering_reexports(self):
+        """The move (peering -> utils) keeps one singleton: arming
+        through either import path arms THE registry."""
+        from ceph_tpu.cluster.peering import crash_points as via_peering
+        from ceph_tpu.utils.crash_points import (
+            crash_points as via_utils,
+        )
+
+        assert via_peering is via_utils
+
+    def test_pause_point_holds_commit_until_release(self, cluster):
+        """The non-destructive action: a pause at primary_before_commit
+        provably holds the commit edge (the op is NOT committed while
+        paused), then completes on release — interleaving control, not
+        just crash injection."""
+        io = cluster.io
+        oid = "pause-obj"
+        io.write_full(oid, OLD)
+        primary = _acting(cluster, oid)[0]
+        pt = crash_points.arm(
+            "rmw.primary_before_commit", "pause", osd=primary,
+            pause_cap=20.0,
+        )
+        comp = io.aio_write_full(oid, NEW)
+        assert pt.wait_hit(15)
+        assert not comp.is_complete(), (
+            "op must not commit while paused at the pre-commit edge"
+        )
+        pt.release()
+        reply = comp.wait_for_complete(30)
+        assert reply.size == len(NEW)
+        assert io.read(oid) == NEW
+
+    def test_osd_filter_scopes_the_point(self, cluster):
+        """A point armed for one osd must not fire on another."""
+        io = cluster.io
+        oid = "scope-obj"
+        acting = _acting(cluster, oid)
+        not_involved = next(
+            i for i in cluster.daemons if i not in acting
+        )
+        pt = crash_points.arm(
+            "rmw.prepare_done", "kill", osd=not_involved
+        )
+        assert io.write_full(oid, OLD) == len(OLD)
+        assert pt.hits == 0
+        assert io.read(oid) == OLD
+
+
+@pytest.mark.net_chaos
+class TestCrashUnderLossyLinks:
+    def test_committed_kill_with_flaky_links_still_exact(self):
+        """The composition: a mid-commit primary kill UNDER the seeded
+        lossy profile — resends ride duplicated/delayed frames and the
+        committed read still returns exactly the committed bytes."""
+        from ceph_tpu.loadgen import LoadCluster
+        from ceph_tpu.msg.messenger import net_faults
+        from ceph_tpu.utils import config
+
+        with config.override(
+            osd_peer_rpc_timeout=1.0, osd_subop_resend_interval=0.2,
+        ):
+            cluster = LoadCluster(
+                n_osds=4, k=2, m=1, pg_num=4, chunk_size=1024,
+                tick_period=0.1, client_op_timeout=2.0,
+                client_max_attempts=12, client_backoff=0.05,
+            )
+            try:
+                cluster.net_flaky(seed=0x5EED)
+                _kill_at_point_and_converge(
+                    cluster, "rmw.primary_committed_before_reply",
+                    "primary", oid="chaos-crash",
+                )
+            finally:
+                net_faults.clear()
+                cluster.shutdown()

@@ -10,25 +10,51 @@
 #
 #   tools/soak.sh            # 50 iterations (the acceptance gate)
 #   tools/soak.sh 10         # quicker local soak
+#   tools/soak.sh --chaos 10 # churn x lossy links: the background
+#                            # primary-kill loadgen loop ALSO runs
+#                            # under the seeded net_flaky profile
+#                            # (>=2% drop + dup + ~50ms p95 delay on
+#                            # every inter-OSD link), and the chaos
+#                            # suites join the rerun set — the
+#                            # composition PR 7 could not yet express
 #   SOAK_SUITES="tests/test_cluster_peering.py" tools/soak.sh 20
 #   SOAK_NO_LOAD=1 tools/soak.sh 5   # skip the background load loop
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+CHAOS=""
+if [ "${1:-}" = "--chaos" ]; then
+    CHAOS=1
+    shift
+fi
 N=${1:-50}
-SUITES=${SOAK_SUITES:-"tests/test_cluster_peering.py tests/test_mon_quorum.py tests/test_peering_fsm.py"}
+DEFAULT_SUITES="tests/test_cluster_peering.py tests/test_mon_quorum.py tests/test_peering_fsm.py"
+if [ -n "$CHAOS" ]; then
+    DEFAULT_SUITES="$DEFAULT_SUITES tests/test_net_faults.py tests/test_rmw_crash_points.py"
+fi
+SUITES=${SOAK_SUITES:-"$DEFAULT_SUITES"}
+LOAD_FLAGS=""
+if [ -n "$CHAOS" ]; then
+    LOAD_FLAGS="--net-fault flaky"
+fi
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 LOAD_PID=""
 if [ -z "${SOAK_NO_LOAD:-}" ]; then
     (
+        seed=1
         while true; do
+            # a fresh seed per lap: every lap is deterministic alone
+            # (same seed => same firings) while the soak as a whole
+            # sweeps the firing space
             python -m ceph_tpu.bench_cli loadgen --smoke \
+                --seed "$seed" $LOAD_FLAGS \
                 >/dev/null 2>&1 || true
+            seed=$((seed + 1))
         done
     ) &
     LOAD_PID=$!
-    echo "soak: background loadgen smoke loop pid=$LOAD_PID"
+    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}"
 fi
 cleanup() {
     if [ -n "$LOAD_PID" ]; then
