@@ -1086,9 +1086,12 @@ def _measure_fused_write_path(result: dict, enc_gbps: float) -> None:
 def _measure_cluster(result: dict, enc_gbps: float) -> None:
     """Live-tier phase (round 8): mixed workload + OSD kill/revive
     over the real mini-cluster — cluster_gbps / cluster_iops /
-    cluster_p99_ms (device clock), the degraded-window cut, and the
-    kernel-vs-cluster efficiency ratio. See loadgen/bench_phase.py
-    for methodology; sized by CEPH_TPU_BENCH_CLUSTER_OPS."""
+    cluster_p99_ms (device clock), the degraded-window cut, the
+    kernel-vs-cluster efficiency ratio, the coalesce/degraded-link
+    A/Bs, and the round-14 tracked-vs-untracked observability A/B
+    (trace_overhead_frac, acceptance < 0.02). See
+    loadgen/bench_phase.py for methodology; sized by
+    CEPH_TPU_BENCH_CLUSTER_OPS."""
     try:
         from ceph_tpu.loadgen.bench_phase import measure_cluster
 

@@ -152,6 +152,22 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                     help="per-OSD-tick op coalescing (A/B flag: run "
                          "the same spec both ways to measure what "
                          "batching buys the live path)")
+    lg.add_argument("--trace-capture", type=int, default=0,
+                    help="capture the N slowest assembled traces "
+                         "(span trees + critical paths + Chrome "
+                         "trace JSON) into the report")
+    lg.add_argument("--forensics-dir", default=None,
+                    help="write a forensics bundle (ops-in-flight + "
+                         "assembled traces + cluster-log tail + perf "
+                         "dump) into this directory when the run is "
+                         "non-green or converges slowly")
+    lg.add_argument("--slow-convergence-s", type=float, default=0.0,
+                    help="with --forensics-dir: also dump when "
+                         "post-kill time_to_recovered_s exceeds this "
+                         "(0 = only on non-green)")
+    lg.add_argument("--force-forensics", action="store_true",
+                    help="treat the run as non-green regardless of "
+                         "outcome (the forensics smoke-test hook)")
     lg.add_argument("--smoke", action="store_true",
                     help="tiny deterministic end-to-end run (CI "
                          "surface): smoke preset, 4 OSDs, one "
@@ -341,6 +357,7 @@ def _run_loadgen(args) -> tuple[float, float]:
         spec = preset(
             "smoke", seed=args.seed,
             device_clock=bool(args.device_clock),
+            trace_capture=args.trace_capture,
         )
         osds, k, m, chunk = 5, 2, 1, 1024
         fault_at = spec.total_ops // 3
@@ -366,6 +383,7 @@ def _run_loadgen(args) -> tuple[float, float]:
             kw["zipf_theta"] = args.zipf_theta
         kw["seed"] = args.seed
         kw["device_clock"] = bool(args.device_clock)
+        kw["trace_capture"] = args.trace_capture
         spec = (
             preset(args.preset, **kw)
             if args.preset else WorkloadSpec(**kw)
@@ -455,6 +473,29 @@ def _run_loadgen(args) -> tuple[float, float]:
             d.coalesce_pc.get("subwrite_batches")
             for d in cluster.daemons.values()
         )
+        # forensics BEFORE teardown and before any raise: wedged ops
+        # are still live, the cluster log still holds this run's tail
+        if args.forensics_dir:
+            from ceph_tpu.loadgen.forensics import (
+                run_is_green,
+                write_bundle,
+            )
+
+            green, why = run_is_green(
+                report, args.slow_convergence_s
+            )
+            if args.force_forensics:
+                green, why = False, "forced (--force-forensics)"
+            if not green:
+                manifest = write_bundle(
+                    args.forensics_dir, report, reason=why,
+                    trace_capture=args.trace_capture or 8,
+                )
+                report["forensics"] = manifest
+                print(
+                    f"forensics bundle: {manifest['dir']} ({why})",
+                    file=sys.stderr,
+                )
         if not report.get("exactly_once"):
             raise RuntimeError(
                 f"op accounting mismatch: issued {report['ops_in']} "

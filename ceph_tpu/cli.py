@@ -574,13 +574,33 @@ def cmd_perf(cl: Cluster, args) -> int:
 
 
 def cmd_health(cl: Cluster, args) -> int:
-    """The `ceph health detail` role (mgr health model)."""
+    """The `ceph health detail` role (mgr health model), plus the
+    cluster-log digest the reference appends as `ceph -s` recent
+    events (slow ops, down-marks, scrub errors, peering stalls)."""
     from ceph_tpu.cluster import Manager
+    from ceph_tpu.utils.cluster_log import cluster_log
+    from ceph_tpu.utils.optracker import op_tracker
 
     report = Manager(cl.mon).health()
     print(report["status"])
     for name, check in sorted(report["checks"].items()):
         print(f"  [{check['severity'].upper()}] {name}: {check['detail']}")
+    live = op_tracker.dump_ops_in_flight()
+    slow = [op for op in live["ops"] if op["slow"]]
+    if slow:
+        print(f"  [WARN] SLOW_OPS: {len(slow)} ops in flight past "
+              "osd_op_complaint_time (dump_ops_in_flight for "
+              "timelines)")
+    summary = cluster_log.summary()
+    print(
+        f"cluster log: {summary['events']} recent events, "
+        f"{summary['warnings']} warnings"
+    )
+    for e in summary["recent_warnings"]:
+        print(
+            f"  {e['severity']} [{e['daemon']}] {e['type']}: "
+            f"{e['message']}"
+        )
     return 0 if report["status"] == "HEALTH_OK" else 1
 
 

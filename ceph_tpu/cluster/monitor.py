@@ -284,6 +284,17 @@ class Monitor:
                 return self.osdmap
             return self._propose(new_rules=((name, norm),))
 
+    @staticmethod
+    def _cluster_event(
+        type: str, msg: str, m: OSDMap, severity: str = "INF"
+    ) -> None:
+        """Health-relevant map changes land in the cluster log (the
+        `ceph.log` "osd.N down" lines the reference mon writes)."""
+        from ceph_tpu.utils.cluster_log import cluster_log
+
+        cluster_log.log("mon", type, msg, severity=severity,
+                        epoch=m.epoch)
+
     def osd_boot(self, osd: int, addr: tuple[str, int]) -> OSDMap:
         """An OSD came up and announced its address (MOSDBoot). A NEW
         device is auto-marked in (mon_osd_auto_mark_new_in); a device
@@ -299,24 +310,37 @@ class Monitor:
             )
             self._failure_reports.pop(osd, None)
             self._down_since.pop(osd, None)
-            return self._propose(new_osds=(info,))
+            m = self._propose(new_osds=(info,))
+        self._cluster_event("osd_boot", f"osd.{osd} boot ({addr[0]}:"
+                            f"{addr[1]})", m)
+        return m
 
     def osd_down(self, osd: int) -> OSDMap:
         with self._command():
             self._check_osd(osd)
             self._down_since.setdefault(osd, self._clock())
             self._failure_reports.pop(osd, None)
-            return self._propose(down=(osd,))
+            m = self._propose(down=(osd,))
+        self._cluster_event(
+            "osd_down", f"osd.{osd} marked down", m, severity="WRN"
+        )
+        return m
 
     def osd_out(self, osd: int) -> OSDMap:
         with self._command():
             self._check_osd(osd)
-            return self._propose(out=(osd,))
+            m = self._propose(out=(osd,))
+        self._cluster_event(
+            "osd_out", f"osd.{osd} marked out", m, severity="WRN"
+        )
+        return m
 
     def osd_in(self, osd: int) -> OSDMap:
         with self._command():
             self._check_osd(osd)
-            return self._propose(in_=(osd,))
+            m = self._propose(in_=(osd,))
+        self._cluster_event("osd_in", f"osd.{osd} marked in", m)
+        return m
 
     def osd_reweight(self, osd: int, weight: float) -> OSDMap:
         with self._command():

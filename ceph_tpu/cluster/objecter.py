@@ -45,6 +45,7 @@ from ceph_tpu.msg.messages import (
 )
 from ceph_tpu.msg.messenger import Connection, Messenger
 from ceph_tpu.utils import tracer
+from ceph_tpu.utils.optracker import NULL_OP, op_tracker
 
 from .osdmap import SHARD_NONE
 
@@ -95,7 +96,7 @@ class _AsyncOp:
     __slots__ = (
         "pool", "oid", "op", "offset", "length", "data", "name",
         "snap", "reqid", "completion", "on_complete", "attempt",
-        "ambiguous", "tid", "osd", "addr", "last", "trace",
+        "ambiguous", "tid", "osd", "addr", "last", "trace", "tracked",
     )
 
     def __init__(
@@ -123,6 +124,9 @@ class _AsyncOp:
         self.addr = None
         self.last = "no attempt made"
         self.trace = (None, None)
+        #: the live-op handle (dump_ops_in_flight): one logical op =
+        #: one TrackedOp across every resend attempt
+        self.tracked = NULL_OP
 
 
 class _Session:
@@ -284,6 +288,7 @@ class Objecter:
             return
         aop.last = f"osd.{aop.osd} timed out"
         aop.ambiguous = True
+        aop.tracked.mark_event("attempt_timeout", osd=aop.osd)
         self._retry(aop)
 
     # -- op submission (the op_submit → _calc_target loop) --------------
@@ -316,6 +321,14 @@ class Objecter:
         # attempt (resends continue the same client trace)
         with tracer.span("client_op", op=op, pool=pool, oid=oid):
             aop.trace = tracer.current()
+            aop.tracked = op_tracker.register(
+                "client_op",
+                daemon=self.perf.name if self.perf is not None
+                else "client",
+                trace_id=aop.trace[0],
+                op=op, pool=pool, oid=oid, reqid=aop.reqid,
+            )
+            aop.tracked.mark_event("queued")
             self._start_attempt(aop)
         return aop.completion
 
@@ -392,6 +405,7 @@ class Objecter:
                 # window full: park behind it — the completion of any
                 # in-flight op on this session pumps the queue
                 sess.queue.append(aop)
+                aop.tracked.mark_event("parked_behind_window", osd=primary)
                 return
             sess.inflight.add(tid)
         self._send_attempt(aop)
@@ -408,11 +422,15 @@ class Objecter:
         except (ConnectionError, OSError):
             aop.last = f"osd.{aop.osd} connection failed"
             aop.ambiguous = True  # the send may still have landed
+            aop.tracked.mark_event("send_failed", osd=aop.osd)
             self._take_waiting(aop.tid)
             with self._lock:
                 self._conns.pop(aop.addr, None)
             self._retry(aop)
             return
+        aop.tracked.mark_event(
+            "sent", osd=aop.osd, attempt=aop.attempt
+        )
         self._at(
             time.monotonic() + self.op_timeout, "deadline", aop, aop.tid
         )
@@ -463,6 +481,7 @@ class Objecter:
             aop.last = (
                 f"osd.{aop.osd} not primary (its epoch {reply.epoch})"
             )
+            aop.tracked.mark_event("eagain", osd=aop.osd)
             self._retry(aop)
             return
         if reply.error == "enoent":
@@ -499,6 +518,10 @@ class Objecter:
                 self.perf.set("op_inflight", self._inflight)
             self.perf.inc("op_error" if error is not None
                           else "op_completed")
+        aop.tracked.finish(
+            "done" if error is None
+            else f"error:{type(error).__name__}"
+        )
         aop.completion._resolve(reply, error, aop.on_complete)
 
     def aio_submit(

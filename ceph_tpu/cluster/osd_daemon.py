@@ -864,6 +864,13 @@ class OSDDaemon:
             self._tick_thread.join(timeout=2.0)
         self.peers.shutdown()
         self.messenger.shutdown()
+        # live ops this daemon owned died with it: finish them so the
+        # tracker (and the slow-op watchdog) never carries corpses
+        from ceph_tpu.utils.optracker import op_tracker
+
+        op_tracker.finish_all(
+            f"osd.{self.osd_id}", event="daemon_stopped"
+        )
 
     # -- map handling ---------------------------------------------------
     def _apply_mon_config(self, osdmap: OSDMap) -> None:
@@ -3888,6 +3895,15 @@ class OSDDaemon:
                     n_err, "errors",
                     "(repaired)" if repaired else "",
                 )
+                from ceph_tpu.utils.cluster_log import cluster_log
+
+                cluster_log.log(
+                    f"osd.{self.osd_id}", "scrub_error",
+                    f"{kind} scrub of pg {pool}/{pgid}: {n_err} "
+                    f"errors{' (repaired)' if repaired else ''}",
+                    severity="WRN", epoch=self.osdmap.epoch,
+                    repaired=repaired,
+                )
         except Exception as e:
             # scrubbing must never take the daemon down; the PG stays
             # due and the next tick retries
@@ -3988,10 +4004,20 @@ class OSDDaemon:
     def _backfill_pg_reserved(
         self, pool: str, pgid: int, pg: _PG
     ) -> None:
+        from ceph_tpu.utils.optracker import op_tracker
+
+        # one tracked op per backfill pass, each object move a marked
+        # item: a wedged backfill shows WHERE it parked (scan, a
+        # specific object's push, the final locked pass)
+        top = op_tracker.register(
+            "backfill", daemon=f"osd.{self.osd_id}",
+            pool=pool, pgid=pgid,
+        )
         try:
             spec = self.osdmap.pools[pool]
             # pass 1: scan + move everything currently known
             hints = self._backfill_scan(pool, pgid, spec, pg)
+            top.mark_event("scanned", objects=len(hints))
             self.log.debug(
                 "backfill pg", f"{pool}/{pgid}:", len(hints),
                 "objects to place"
@@ -4005,9 +4031,11 @@ class OSDDaemon:
                 # re-pushes; discarding after would erase that evidence
                 with self._pg_lock:
                     pg.backfill_dirty.discard(oid)
+                top.mark_event("item", oid=oid)
                 self._backfill_object(pool, pgid, pg, oid, hints[oid])
             # final pass: writes that landed mid-backfill, under the
             # op lock so nothing new sneaks in; then drop pg_temp
+            top.mark_event("final_pass")
             with self._op_lock:
                 while True:
                     with self._pg_lock:
@@ -4021,9 +4049,11 @@ class OSDDaemon:
                 pg.backfill_done = True  # _on_map drops, not re-temps
                 self.monitor.pg_temp_clear(pool, pgid)
             self._backfill_gc(pool, pgid, pg, spec)
-        except Exception:
+            top.finish("done")
+        except Exception as e:
             # survivors short / peer died mid-pass: keep pg_temp (the
             # PG stays served from the old layout); tick() retries
+            top.finish(f"error:{type(e).__name__}")
             pg.backfilling = False
 
     def _backfill_scan(

@@ -208,6 +208,9 @@ class PgPeeringFsm:
         self._pass_started = None  # monotonic, reset -> active timing
         #: transition trail (bounded) — test/debug observability
         self.history: deque = deque(maxlen=64)
+        #: live tracked op of the pass in flight (dump_ops_in_flight
+        #: shows a wedged election with its state timeline)
+        self._pass_top = None
 
     # -- event surface --------------------------------------------------
     def post_interval(self) -> None:
@@ -263,14 +266,39 @@ class PgPeeringFsm:
                 if kind == "catchup_admit":
                     self._handle_admit(**kw)
                 else:
-                    self._peer_pass()
+                    self._run_tracked_pass()
             except Exception as e:
                 self.daemon.log.error(
                     "pg", f"{self.pg.pool}/{self.pg.pgid}:",
                     "peering pass failed",
                     f"({type(e).__name__}: {e}); gate stays closed",
                 )
+                from ceph_tpu.utils.cluster_log import cluster_log
+
+                cluster_log.log(
+                    f"osd.{self.daemon.osd_id}", "peering_stalled",
+                    f"pg {self.pg.pool}/{self.pg.pgid} peering pass "
+                    f"failed ({type(e).__name__}: {e}); gate stays "
+                    "closed",
+                    severity="WRN", epoch=self.daemon.osdmap.epoch,
+                )
                 self._enter(INCOMPLETE)
+
+    def _run_tracked_pass(self) -> None:
+        """One peering pass as a live tracked op: every state entry is
+        a mark_event, so a pass wedged mid-election shows up in
+        dump_ops_in_flight with exactly where it is parked."""
+        from ceph_tpu.utils.optracker import op_tracker
+
+        with op_tracker.track(
+            "peering", daemon=f"osd.{self.daemon.osd_id}",
+            pool=self.pg.pool, pgid=self.pg.pgid,
+        ) as top:
+            self._pass_top = top
+            try:
+                self._peer_pass()
+            finally:
+                self._pass_top = None
 
     def _enter(self, state: str) -> None:
         now = time.monotonic()
@@ -279,6 +307,8 @@ class PgPeeringFsm:
             self.daemon.peering_pc.hinc("state_dwell_ms", dwell_ms)
         except Exception:
             pass  # counters must never fault a transition
+        if self._pass_top is not None:
+            self._pass_top.mark_event(state)
         self.history.append((self.state, state))
         self.state = state
         self._entered_at = now
@@ -326,6 +356,14 @@ class PgPeeringFsm:
             # cannot establish authority. Ops eagain until a map
             # brings members back.
             self._enter(DOWN)
+            from ceph_tpu.utils.cluster_log import cluster_log
+
+            cluster_log.log(
+                f"osd.{d.osd_id}", "pg_down",
+                f"pg {pg.pool}/{pg.pgid} down: {live} live members "
+                f"< k={pg.rmw.sinfo.k}",
+                severity="WRN", epoch=epoch0,
+            )
             return
 
         # -- GetInfo: fence + query every votable member ----------------
@@ -461,6 +499,14 @@ class PgPeeringFsm:
         d.log.info(
             "pg", f"{pg.pool}/{pg.pgid}:", "peered at epoch", epoch0,
             "(authority: osd.", best, ")"
+        )
+        from ceph_tpu.utils.cluster_log import cluster_log
+
+        cluster_log.log(
+            f"osd.{d.osd_id}", "pg_peered",
+            f"pg {pg.pool}/{pg.pgid} peered at epoch {epoch0} "
+            f"(authority: osd.{best})",
+            epoch=epoch0,
         )
         # Drain every recovering mark the primary now owns: _on_map
         # marks healed (down -> up) members on EVERY instance, but

@@ -28,6 +28,7 @@ mid-run to measure degraded-window throughput and time-to-recovered.
 from .cluster import LoadCluster
 from .driver import LoadGenerator, run_spec
 from .faults import FaultEvent, FaultSchedule
+from .forensics import run_is_green, write_bundle
 from .histogram import Log2Histogram
 from .recorder import DeviceClock, RunRecorder
 from .spec import (
@@ -59,5 +60,7 @@ __all__ = [
     "parse_mix",
     "patch_bytes",
     "preset",
+    "run_is_green",
     "run_spec",
+    "write_bundle",
 ]

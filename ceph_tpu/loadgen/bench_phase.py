@@ -28,6 +28,13 @@ Round 10 adds the serving-tier observables:
   with the dispatch mesh installed over C devices (the chip axis) —
   GB/s and IOPS vs OSD count / chip count in one run.
 
+Round 14 adds the observability-plane A/B: the same workload with
+the live-op tracker + tracer OFF (``cluster_gbps_tracked`` /
+``cluster_gbps_untracked`` / ``trace_overhead_frac`` = 1 −
+tracked/untracked, acceptance < 0.02) — proving the always-on
+plane (TrackedOp registration + event marks across objecter, RMW
+and sub-op layers) is cheap enough to leave on.
+
 Sized by ``CEPH_TPU_BENCH_CLUSTER_OPS`` (default 240 ops at queue
 depth ``CEPH_TPU_BENCH_CLUSTER_QD`` = 32 over
 ``CEPH_TPU_BENCH_CLUSTER_OBJECTS`` = 256 objects of 256 KiB; tunnel
@@ -184,9 +191,33 @@ def measure_cluster(result: dict, enc_gbps: float) -> None:
             report["gbps"] / off["gbps"], 4
         )
 
+    # -- A/B: tracked vs untracked (round-14 observability plane) —
+    # the SAME seed and sizing with the live-op tracker + tracer off,
+    # pinning what the always-on plane costs the smallop-heavy path.
+    # trace_overhead_frac = 1 - tracked/untracked; acceptance < 0.02
+    # (cheap enough to leave on), within-run like the coalesce A/B.
+    scale_ops = max(total_ops // 2, 40)
+    tracked = _leg(scale_ops, qd, max_objects, seed=0x7ACE)
+    from ceph_tpu.utils import tracer as _tracer
+
+    with config.override(osd_enable_op_tracker=False):
+        _was = _tracer.enabled
+        _tracer.enabled = False
+        try:
+            untracked = _leg(
+                scale_ops, qd, max_objects, seed=0x7ACE
+            )
+        finally:
+            _tracer.enabled = _was
+    result["cluster_gbps_tracked"] = tracked["gbps"]
+    result["cluster_gbps_untracked"] = untracked["gbps"]
+    if untracked["gbps"]:
+        result["trace_overhead_frac"] = round(
+            max(1.0 - tracked["gbps"] / untracked["gbps"], 0.0), 6
+        )
+
     # -- scaling rows: GB/s and IOPS vs OSD count, then vs chip count
     # (dispatch mesh over C devices). Half-length legs, no faults.
-    scale_ops = max(total_ops // 2, 40)
     for n_osds in (6, 9, 12):
         rep = _leg(
             scale_ops, qd, max_objects, n_osds=n_osds,

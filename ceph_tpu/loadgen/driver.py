@@ -622,6 +622,16 @@ class LoadGenerator:
         if self.faults is not None:
             report["fault"] = self.faults.metrics(self.recorder)
             report["recovered"] = self.cluster.is_recovered()
+        if self.spec.trace_capture:
+            # the N slowest assembled traces of the run (span trees +
+            # critical paths + Chrome trace JSON): the in-process
+            # cluster shares one tracer/tracker, so the process
+            # snapshot IS the all-daemons merge
+            from ceph_tpu.utils.trace_assembly import capture_traces
+
+            report["traces"] = capture_traces(
+                limit=self.spec.trace_capture
+            )
         return report
 
 

@@ -79,6 +79,10 @@ class WorkloadSpec:
     #: slot — queue depth actually reaches the wire at qd ≫ 12.
     #: False restores the classic one-thread-per-slot closed loop.
     async_submit: bool = True
+    #: capture the N slowest assembled traces at end of run into the
+    #: report (``report["traces"]``: span trees + critical paths +
+    #: Chrome trace JSON — utils/trace_assembly.py); 0 = off
+    trace_capture: int = 0
 
     def __post_init__(self) -> None:
         for name in self.mix:

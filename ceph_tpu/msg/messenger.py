@@ -167,11 +167,20 @@ class NetFaultPlane:
         ``msgr_fault_plane`` config gate (evaluated at arm time) is
         the operator escape hatch that keeps armed rules inert."""
         from ceph_tpu.utils import config
+        from ceph_tpu.utils.cluster_log import cluster_log
 
         with self._lock:
             self._rules.append((src, dst, rule))
             self._gen += 1
             self.active = bool(config.get("msgr_fault_plane"))
+        # the arm lands in the cluster log so a chaos run's fallout
+        # (slow ops, down-marks) lines up against its cause
+        cluster_log.log(
+            "net", "net_fault_armed",
+            f"link rule armed {src} -> {dst}: {rule!r}"
+            + ("" if self.active else " (inert: msgr_fault_plane=false)"),
+            severity="WRN", seed=self.seed,
+        )
 
     def partition(
         self, names, peers: str = "*", asymmetric: bool = False
@@ -191,10 +200,18 @@ class NetFaultPlane:
     def clear(self) -> None:
         """Disarm everything and flush held/delayed frames NOW."""
         with self._lock:
+            had_rules = bool(self._rules)
             self._rules.clear()
             self._gen += 1
             self.active = False
             lanes = list(self._lanes.values())
+        if had_rules:
+            from ceph_tpu.utils.cluster_log import cluster_log
+
+            cluster_log.log(
+                "net", "net_fault_cleared",
+                "fault plane cleared (held/delayed frames flushed)",
+            )
         held = []
         for lane in lanes:
             with lane.lock:

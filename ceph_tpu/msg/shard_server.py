@@ -153,16 +153,22 @@ class ShardServer:
 class _Pending:
     __slots__ = (
         "shard", "oid", "on_reply", "deadline", "is_read", "soft",
-        "resend", "retry_at", "tries",
+        "resend", "retry_at", "tries", "tracked",
     )
 
     def __init__(self, shard, oid, on_reply, deadline, is_read,
-                 soft=False, resend=None, retry_at=None):
+                 soft=False, resend=None, retry_at=None,
+                 tracked=None):
+        from ceph_tpu.utils.optracker import NULL_OP
+
         self.shard = shard
         self.oid = oid
         self.on_reply = on_reply
         self.deadline = deadline
         self.is_read = is_read
+        #: live-op handle: a wedged peer RPC (lost frame, dead peer)
+        #: shows in dump_ops_in_flight with how long it has waited
+        self.tracked = tracked if tracked is not None else NULL_OP
         #: soft RPCs are EXPECTED to wait (delayed reservation
         #: grants): expiry wakes the waiter but must not mark the
         #: merely-busy peer down
@@ -274,6 +280,9 @@ class NetShardBackend:
                 with self._lock:
                     entry = self._waiting.pop((tid, msg.shard), None)
                 if entry is not None:
+                    entry.tracked.finish(
+                        "replied" if committed else "fenced"
+                    )
                     self._inbox.put(
                         lambda e=entry, t=tid, c=committed: e.on_reply(
                             ECSubWriteReply(t, msg.shard, c)
@@ -291,6 +300,7 @@ class NetShardBackend:
         with self._lock:
             entry = self._waiting.pop((msg.tid, msg.shard), None)
         if entry is not None:
+            entry.tracked.finish("replied")
             self._inbox.put(lambda: entry.on_reply(msg))
         elif isinstance(msg, (ECSubWriteReply, ECSubWriteBatchReply)):
             self._absorbed()
@@ -312,12 +322,24 @@ class NetShardBackend:
         retry_at = None
         if resend is not None and self.resend_interval > 0:
             retry_at = time.monotonic() + self.resend_interval
+        tracked = None
+        if not soft:
+            # soft RPCs (delayed reservation grants) are EXPECTED to
+            # wait — tracking them would feed false slow-op complaints
+            from ceph_tpu.utils.optracker import op_tracker
+
+            tracked = op_tracker.register(
+                "peer_subop", daemon=self.messenger.name,
+                to=f"osd.{shard}", tid=tid,
+                kind="read" if is_read else "write", oid=oid,
+            )
         with self._lock:
             self._waiting[(tid, shard)] = _Pending(
                 shard, oid, on_reply,
                 deadline if deadline is not None
                 else time.monotonic() + self.timeout,
                 is_read, soft, resend=resend, retry_at=retry_at,
+                tracked=tracked,
             )
 
     def _send(self, shard: int, msg, tid: int) -> bool:
@@ -326,7 +348,9 @@ class NetShardBackend:
             return True
         except (ConnectionError, OSError, KeyError):
             with self._lock:
-                self._waiting.pop((tid, shard), None)
+                entry = self._waiting.pop((tid, shard), None)
+            if entry is not None:
+                entry.tracked.finish("send_failed")
             self._mark_down(shard, "send failed")
             return False
 
@@ -383,6 +407,7 @@ class NetShardBackend:
                     entry.retry_at = now + self.resend_interval * (
                         2 ** entry.tries
                     )
+                    entry.tracked.mark_event("resent", tries=entry.tries)
                     resends.append(entry.resend)
         for fire in resends:  # outside the lock: sends can block
             try:
@@ -390,6 +415,7 @@ class NetShardBackend:
             except (ConnectionError, OSError, KeyError):
                 pass  # dead link: the deadline path judges it
         for (tid, shard), entry in expired:
+            entry.tracked.finish("rpc_timeout")
             if not entry.soft:
                 self._mark_down(shard, "rpc timeout")
             if entry.is_read:
@@ -696,9 +722,14 @@ class NetShardBackend:
                 # the whole frame is lost: drop every item's pending
                 # entry and mark the peer down, exactly like a failed
                 # solo send (writes park; recovery's problem)
+                dropped = []
                 with self._lock:
                     for tid, *_rest in items:
-                        self._waiting.pop((tid, shard), None)
+                        e = self._waiting.pop((tid, shard), None)
+                        if e is not None:
+                            dropped.append(e)
+                for e in dropped:
+                    e.tracked.finish("send_failed")
                 self._mark_down(shard, "send failed")
 
     def submit_shard_txn(
@@ -779,4 +810,11 @@ class NetShardBackend:
 
     def shutdown(self) -> None:
         self.stop_heartbeat()
+        with self._lock:
+            pending = list(self._waiting.values())
+            self._waiting.clear()
+        for entry in pending:
+            # a stopped backend's RPCs died with it — the live tracker
+            # must not carry (and complain about) them forever
+            entry.tracked.finish("backend_shutdown")
         self.messenger.shutdown()

@@ -146,29 +146,47 @@ class RecoveryBackend:
         overrides size_fn when the caller knows the object size from a
         source the local state doesn't reflect (a peer's report)."""
         from ceph_tpu.utils import tracer
+        from ceph_tpu.utils.optracker import op_tracker
 
         drain = getattr(self.backend, "drain_until", None)
         op = self.open_recovery_op(oid, missing)
         op.extent_override = extents
         op.size_override = size
-        with tracer.span("ec_recover", oid=oid, missing=sorted(missing)):
-            while op.state is not RecoveryState.COMPLETE:
-                before = op.state
-                self.continue_recovery_op(op)
-                if op.state is before and op.error is not None:
-                    break
-                if op.state is before:
-                    if drain is not None and op.pending_reads:
-                        drain(lambda: not op.pending_reads or op.error)
-                    elif drain is not None and op.pending_pushes:
-                        drain(lambda: not op.pending_pushes)
-                    else:
-                        raise RuntimeError(
-                            f"recovery stalled in {op.state} for {oid!r}"
-                        )
+        tracked = op_tracker.register(
+            "recovery_push", daemon=self.perf.name, oid=oid,
+            missing=sorted(missing),
+        )
+        try:
+            with tracer.span(
+                "ec_recover", oid=oid, missing=sorted(missing)
+            ):
+                while op.state is not RecoveryState.COMPLETE:
+                    before = op.state
+                    self.continue_recovery_op(op)
+                    if op.state is not before:
+                        tracked.mark_event(op.state.value.lower())
+                    if op.state is before and op.error is not None:
+                        break
+                    if op.state is before:
+                        if drain is not None and op.pending_reads:
+                            drain(
+                                lambda: not op.pending_reads or op.error
+                            )
+                        elif drain is not None and op.pending_pushes:
+                            drain(lambda: not op.pending_pushes)
+                        else:
+                            raise RuntimeError(
+                                f"recovery stalled in {op.state} "
+                                f"for {oid!r}"
+                            )
+        except BaseException as e:
+            tracked.finish(f"error:{type(e).__name__}")
+            raise
         if op.error is not None:
+            tracked.finish(f"error:{type(op.error).__name__}")
             self.perf.inc("errors")
             raise op.error
+        tracked.finish("done")
         self.perf.inc("recovery_ops")
         self.perf.inc("recovery_read_bytes", op.read_bytes)
         self.perf.inc("recovered_bytes", op.recovered_bytes)

@@ -38,6 +38,7 @@ import numpy as np
 from ceph_tpu.codecs.interface import Flag
 from ceph_tpu.store import Transaction
 from ceph_tpu.utils.crash_points import crash_points
+from ceph_tpu.utils.optracker import NULL_OP, op_tracker
 
 from .extent_cache import CacheOp, ECExtentCache
 from .extents import ExtentSet
@@ -217,6 +218,9 @@ class ClientOp:
         self.notified = False
         self.error: Exception | None = None
         self.t_submit: float | None = None
+        #: live-op handle (dump_ops_in_flight): queued -> dispatched
+        #: -> waiting_for_subops -> committed -> done
+        self.tracked = NULL_OP
 
 
 class ShardBackend:
@@ -462,6 +466,7 @@ class RMWPipeline:
                         "interval changed - op requeued for resend"
                     )
                     op.committed = True
+                    op.tracked.mark_event("interval_fenced")
                     self.perf.inc("aborts")
                     stale.append(op)
             self.cache.on_change()
@@ -471,6 +476,20 @@ class RMWPipeline:
             self.cache.write_done(op.cache_op, ShardExtentMap(self.sinfo))
         with self._ack_lock:
             self._check_commit_order()
+
+    def _track(self, op: ClientOp, kind: str) -> None:
+        """Register the op with the live tracker under the OWNING
+        daemon's name (pipeline-grade perf names collapse to osd.N);
+        the commit-order pop finishes it."""
+        op.tracked = op_tracker.register(
+            kind,
+            daemon=(
+                f"osd.{self.owner.osd_id}" if self.owner is not None
+                else self.perf.name
+            ),
+            oid=op.oid, tid=op.tid,
+        )
+        op.tracked.mark_event("queued")
 
     # -- client entry (ECBackend::submit_transaction analog) -----------
     def submit(
@@ -490,6 +509,7 @@ class RMWPipeline:
         op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
+        self._track(op, "rmw_write")
         self.perf.inc("write_ops")
         self.perf.inc("write_bytes", len(data))
 
@@ -556,12 +576,16 @@ class RMWPipeline:
         op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
+        self._track(op, "rmw_remove")
 
         def dispatch(cop, _op=op) -> None:
             try:
                 live = set(self.backend.avail_shards())
                 if self.pglog is not None:
                     self.pglog.append_delete(_op.tid, oid)
+                _op.tracked.mark_event(
+                    "waiting_for_subops", n=len(live)
+                )
                 _op.pending_shards = set(live)
                 _op.written = ShardExtentMap(self.sinfo)
                 self._object_sizes.pop(oid, None)
@@ -622,6 +646,7 @@ class RMWPipeline:
         op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
+        self._track(op, "rmw_truncate")
         sinfo = self.sinfo
 
         def dispatch(cop, _op=op) -> None:
@@ -674,6 +699,9 @@ class RMWPipeline:
                 # stale tail content must leave the cache before any
                 # later op snapshots it
                 self.cache.invalidate_object(oid)
+                _op.tracked.mark_event(
+                    "waiting_for_subops", n=len(live)
+                )
                 _op.pending_shards = set(live)
                 _op.written = ShardExtentMap(sinfo)
                 for shard, txn in txns:
@@ -706,6 +734,7 @@ class RMWPipeline:
         op.t_submit = time.perf_counter()
         self._next_tid += 1
         self._inflight[op.tid] = op
+        self._track(op, "rmw_attrs")
         updates = dict(updates)
 
         def dispatch(cop, _op=op) -> None:
@@ -713,6 +742,9 @@ class RMWPipeline:
                 live = set(self.backend.avail_shards())
                 if self.pglog is not None:
                     self.pglog.append_xattrs(_op.tid, oid, updates)
+                _op.tracked.mark_event(
+                    "waiting_for_subops", n=len(live)
+                )
                 _op.pending_shards = set(live)
                 _op.written = ShardExtentMap(self.sinfo)
                 for shard in sorted(live):
@@ -820,6 +852,7 @@ class RMWPipeline:
         object) and complete in order with the error."""
         op.error = err
         op.committed = True
+        op.tracked.mark_event("aborted", err=type(err).__name__)
         self.perf.inc("aborts")
         if op.cache_op is not None and op.written is None:
             self.cache.write_done(op.cache_op, ShardExtentMap(self.sinfo))
@@ -834,6 +867,7 @@ class RMWPipeline:
         if err is not None:
             self._abort_op(op, err)
             return
+        op.tracked.mark_event("cache_ready")
         try:
             self._cache_ready_inner(op)
         except Exception as e:
@@ -982,6 +1016,10 @@ class RMWPipeline:
                     op.extra_attrs,
                 ),
             )
+        op.tracked.mark_event(
+            "encoded",
+            strategy="delta" if op.plan.do_parity_delta else "full",
+        )
         # crash point: plan chosen, stripe encoded, pg log appended —
         # nothing on the wire yet. A kill here loses the op entirely
         # (no shard saw it); the client's resend re-runs it whole.
@@ -989,6 +1027,7 @@ class RMWPipeline:
             "rmw.prepare_done", daemon=self.owner, oid=op.oid,
             tid=op.tid,
         )
+        op.tracked.mark_event("waiting_for_subops", n=len(live))
         # build every txn before the first dispatch: a synchronous ack
         # (local stores) must see the complete written map
         for shard, txn in txns:
@@ -1053,6 +1092,7 @@ class RMWPipeline:
                 self.pglog.ack(shard, op.tid)
             op.pending_shards.discard(shard)
             op.acked_shards.add(shard)
+            op.tracked.mark_event("subop_ack", shard=shard)
             if not op.pending_shards and not op.committed:
                 # crash point: every sub-write durable on its shard,
                 # the commit decision not yet taken. A kill here is
@@ -1064,6 +1104,7 @@ class RMWPipeline:
                     oid=op.oid, tid=op.tid,
                 )
                 op.committed = True
+                op.tracked.mark_event("committed")
                 finish = True
         # cache release OUTSIDE the ack lock: write_done may dispatch
         # the next queued op for this object, whose RMW backend read
@@ -1094,6 +1135,7 @@ class RMWPipeline:
             for op in list(self._inflight.values()):
                 if shard in op.pending_shards:
                     op.pending_shards.discard(shard)
+                    op.tracked.mark_event("subop_lost", shard=shard)
                     if not op.pending_shards and not op.committed:
                         if len(op.acked_shards) < self.sinfo.k:
                             op.error = IOError(
@@ -1144,6 +1186,10 @@ class RMWPipeline:
                 return
             self._inflight.pop(tid)
             op.notified = True
+            op.tracked.finish(
+                "done" if op.error is None
+                else f"error:{type(op.error).__name__}"
+            )
             if op.t_submit is not None:
                 self.perf.ainc(
                     "commit_lat", time.perf_counter() - op.t_submit

@@ -14,6 +14,8 @@ from .perf_counters import (
 )
 from .config import ConfigProxy, Option, config
 from .trace import Tracer, tracer
+from .optracker import NULL_OP, OpTracker, TrackedOp, op_tracker
+from .cluster_log import ClusterLog, cluster_log
 from .admin_socket import AdminSocket, admin_socket
 
 __all__ = [
@@ -29,6 +31,12 @@ __all__ = [
     "config",
     "Tracer",
     "tracer",
+    "NULL_OP",
+    "OpTracker",
+    "TrackedOp",
+    "op_tracker",
+    "ClusterLog",
+    "cluster_log",
     "AdminSocket",
     "admin_socket",
 ]

@@ -110,6 +110,28 @@ def _register_builtins(sock: AdminSocket) -> None:
         "recently completed trace spans",
     )
 
+    from ceph_tpu.utils.cluster_log import cluster_log
+    from ceph_tpu.utils.optracker import op_tracker
+
+    sock.register(
+        "dump_ops_in_flight",
+        lambda daemon=None: op_tracker.dump_ops_in_flight(daemon),
+        "live tracked ops, oldest first, with event timelines",
+    )
+    sock.register(
+        "perf reset",
+        lambda name=None: perf_collection.reset(name),
+        "zero one named counter set, or all of them",
+    )
+    sock.register(
+        "log last",
+        lambda n=20, daemon=None, severity=None: cluster_log.last(
+            int(n), daemon, severity
+        ),
+        "recent cluster-log events (the ceph.log / `ceph log last` "
+        "analog; severity filters at-or-above)",
+    )
+
     from ceph_tpu.utils.log import root_log
 
     sock.register(

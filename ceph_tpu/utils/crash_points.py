@@ -90,6 +90,20 @@ class ArmedPoint:
     def _fire(self, daemon, pg, ctx) -> None:
         self.hits += 1
         self._hit.set()
+        try:
+            # chaos runs read the cluster log to line injected faults
+            # up against their fallout; lazy import (leaf module)
+            from .cluster_log import cluster_log
+
+            cluster_log.log(
+                f"osd.{daemon.osd_id}" if daemon is not None else "proc",
+                "crash_point",
+                f"{self.name} fired "
+                f"({self.action if isinstance(self.action, str) else 'callable'})",
+                severity="WRN",
+            )
+        except Exception:
+            pass  # observability must never change the injected fault
         if self.action == "pause":
             # capped: an un-released point must not wedge the FSM
             # forever if a test dies before release()

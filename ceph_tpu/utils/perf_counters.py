@@ -94,6 +94,23 @@ class PerfCounters:
             v = self._values[key]
             return list(v) if isinstance(v, list) else v
 
+    def reset(self) -> None:
+        """Zero every counter, gauge, time accumulator, average pair
+        and histogram bucket (the ``perf reset`` admin command): bench
+        A/B legs and soak iterations start from clean counters instead
+        of differencing against a snapshot."""
+        with self._lock:
+            for key, spec in self._schema.items():
+                if spec["type"] is CounterType.AVG:
+                    self._values[key] = [0, 0.0]
+                elif spec["type"] is CounterType.HISTOGRAM:
+                    self._values[key] = [0] * (len(spec["buckets"]) + 1)
+                    self._hist_sums[key] = 0.0
+                elif spec["type"] in (CounterType.U64, CounterType.GAUGE):
+                    self._values[key] = 0
+                else:
+                    self._values[key] = 0.0
+
     def dump(self) -> dict:
         out: dict[str, object] = {}
         with self._lock:
@@ -166,6 +183,23 @@ class PerfCountersCollection:
     def deregister(self, name: str) -> None:
         with self._lock:
             self._sets.pop(name, None)
+
+    def reset(self, name: str | None = None) -> int:
+        """Zero one named set, or every registered set (``perf
+        reset`` over the admin socket). Returns how many sets were
+        reset; an unknown name raises KeyError like the other admin
+        lookups."""
+        with self._lock:
+            if name is None:
+                targets = list(self._sets.values())
+            else:
+                pc = self._sets.get(name)
+                if pc is None:
+                    raise KeyError(f"no counter set {name!r}")
+                targets = [pc]
+        for pc in targets:
+            pc.reset()
+        return len(targets)
 
     def dump(self) -> dict:
         with self._lock:

@@ -27,6 +27,26 @@ from dataclasses import dataclass, field
 #: tier's OS-process hosts (the blkin trace-id role)
 _TRACE_PREFIX = f"{os.getpid():x}-{secrets.token_hex(2)}"
 
+#: jax.profiler.TraceAnnotation, resolved ONCE on first span instead
+#: of an import+try/except per span (the round-14 hot-path fix: the
+#: per-span import dominated small-op span cost). Lazy rather than
+#: import-time so ``import ceph_tpu`` stays jax-free for the
+#: multichip dryrun (the admin-socket builtin-registration contract).
+#: Sentinel False = unresolved; None = resolved-absent.
+_ANNOTATION_CLS: "object" = False
+
+
+def _annotation_cls():
+    global _ANNOTATION_CLS
+    if _ANNOTATION_CLS is False:
+        try:
+            import jax.profiler
+
+            _ANNOTATION_CLS = jax.profiler.TraceAnnotation
+        except Exception:
+            _ANNOTATION_CLS = None
+    return _ANNOTATION_CLS
+
 
 @dataclass
 class Span:
@@ -42,6 +62,12 @@ class Span:
     #: one id per END-TO-END operation, carried across the wire
     #: (client op -> primary -> replica sub-ops all share it)
     trace_id: str | None = None
+    #: monotonic clock at span open, taken at the SAME instant as the
+    #: wall-clock ``start``: trace assembly orders spans and computes
+    #: intervals on (start_mono, start_mono + duration) within a
+    #: process — mixing wall starts with perf_counter durations made
+    #: cross-thread ordering wobble by the wall clock's granularity
+    start_mono: float | None = None
 
     def as_dict(self) -> dict:
         return {
@@ -49,6 +75,7 @@ class Span:
             "parent_id": self.parent_id,
             "name": self.name,
             "start": self.start,
+            "start_mono": self.start_mono,
             "duration": self.duration,
             "tags": self.tags,
             "trace_id": self.trace_id,
@@ -80,20 +107,20 @@ class Tracer:
             if stack
             else f"{_TRACE_PREFIX}-{next(self._ids)}"
         )
+        t0 = time.perf_counter()
         sp = Span(
             f"{_TRACE_PREFIX}-{next(self._ids)}", parent, name,
-            time.time(), tags=tags, trace_id=trace_id,
+            time.time(), tags=tags, trace_id=trace_id, start_mono=t0,
         )
         stack.append(sp)
-        t0 = time.perf_counter()
         annotation = None
-        try:
-            import jax.profiler
-
-            annotation = jax.profiler.TraceAnnotation(name)
-            annotation.__enter__()
-        except Exception:
-            annotation = None
+        cls = _annotation_cls()
+        if cls is not None:
+            try:
+                annotation = cls(name)
+                annotation.__enter__()
+            except Exception:
+                annotation = None
         try:
             yield sp
         finally:

@@ -215,3 +215,74 @@ class TestPeeringCounters:
             client.shutdown()
             for d in daemons:
                 d.stop()
+
+
+class TestObservabilityPlaneCounters:
+    """ISSUE 10 satellite: every NEW counter set of the observability
+    plane (per-daemon optracker slow-op sets, the cluster_log event
+    set) renders in Prometheus text format with the ``set`` label,
+    same as ``osd.N.net`` does today."""
+
+    def test_optracker_set_rendered(self):
+        from ceph_tpu.utils.optracker import OpTracker
+        from ceph_tpu.utils.perf_counters import perf_collection
+
+        t = OpTracker()
+        pc = t._perf_for("osd.88")
+        pc.inc("ops_tracked", 5)
+        pc.set("slow_ops", 2)
+        pc.inc("slow_ops_total", 3)
+        pc.hinc("slow_op_age_s", 31.5)
+        try:
+            text = render_exposition()
+        finally:
+            perf_collection.deregister("osd.88.optracker")
+        samples = parse_exposition(text)
+        label = 'set="osd.88.optracker"'
+        assert samples[f"ceph_tpu_ops_tracked{{{label}}}"] == 5
+        assert samples[f"ceph_tpu_slow_ops{{{label}}}"] == 2
+        assert samples[f"ceph_tpu_slow_ops_total{{{label}}}"] == 3
+        # the age histogram carries cumulative buckets, count AND
+        # _sum (live-mean support, like every histogram here)
+        assert samples[
+            f"ceph_tpu_slow_op_age_s_count{{{label}}}"
+        ] == 1
+        assert samples[
+            f"ceph_tpu_slow_op_age_s_sum{{{label}}}"
+        ] == pytest.approx(31.5)
+        assert f"ceph_tpu_slow_op_age_s_bucket{{{label}" in text
+
+    def test_cluster_log_set_rendered(self):
+        from ceph_tpu.utils.cluster_log import cluster_log
+        from ceph_tpu.utils.perf_counters import perf_collection
+
+        before = perf_collection.dump().get("cluster_log")
+        cluster_log.log("exp", "probe", "warn me", severity="WRN")
+        samples = parse_exposition(render_exposition())
+        label = 'set="cluster_log"'
+        assert samples[f"ceph_tpu_events{{{label}}}"] >= 1
+        warn_before = (before or {}).get("events_warn", 0)
+        assert samples[
+            f"ceph_tpu_events_warn{{{label}}}"
+        ] == warn_before + 1
+
+    def test_slow_op_flow_reaches_exporter(self):
+        """End to end: a live op crossing the complaint age shows on
+        the exporter as a non-zero slow_ops gauge for its daemon."""
+        import time
+
+        from ceph_tpu.utils import config
+        from ceph_tpu.utils.optracker import op_tracker
+
+        with config.override(osd_op_complaint_time=0.05):
+            top = op_tracker.register("x", daemon="osd.89")
+            deadline = time.monotonic() + 5.0
+            while not top.slow and time.monotonic() < deadline:
+                op_tracker.poke()
+                time.sleep(0.02)
+            assert top.slow
+            samples = parse_exposition(render_exposition())
+            assert samples[
+                'ceph_tpu_slow_ops{set="osd.89.optracker"}'
+            ] >= 1
+            top.finish()

@@ -1,0 +1,1 @@
+# tools/ is importable so tests can drive trace_tool directly.

@@ -19,6 +19,16 @@
 #                            # composition PR 7 could not yet express
 #   SOAK_SUITES="tests/test_cluster_peering.py" tools/soak.sh 20
 #   SOAK_NO_LOAD=1 tools/soak.sh 5   # skip the background load loop
+#
+# Forensics: every background loadgen lap runs with --forensics-dir.
+# A lap that goes non-green (verify failures, accounting mismatch, op
+# errors, failed recovery) OR converges slower than
+# SOAK_SLOW_CONVERGENCE_S after its kill (default 45 s — the ~1/7
+# minute-scale outlier's trigger) leaves a bundle under
+# $SOAK_FORENSICS_DIR/<stamp>/: ops-in-flight timelines, assembled
+# traces (text + Chrome JSON), the cluster-log tail, and a perf dump.
+# SOAK_FORCE_FORENSICS=1 forces a bundle on the first lap (the
+# plumbing smoke test).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -37,6 +47,12 @@ LOAD_FLAGS=""
 if [ -n "$CHAOS" ]; then
     LOAD_FLAGS="--net-fault flaky"
 fi
+FORENSICS_DIR=${SOAK_FORENSICS_DIR:-/tmp/soak-forensics}
+SLOW_S=${SOAK_SLOW_CONVERGENCE_S:-45}
+FORENSICS_FLAGS="--forensics-dir $FORENSICS_DIR --slow-convergence-s $SLOW_S"
+if [ -n "${SOAK_FORCE_FORENSICS:-}" ]; then
+    FORENSICS_FLAGS="$FORENSICS_FLAGS --force-forensics"
+fi
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 LOAD_PID=""
@@ -46,15 +62,20 @@ if [ -z "${SOAK_NO_LOAD:-}" ]; then
         while true; do
             # a fresh seed per lap: every lap is deterministic alone
             # (same seed => same firings) while the soak as a whole
-            # sweeps the firing space
+            # sweeps the firing space. Non-green / slow-convergence
+            # laps leave a forensics bundle under $FORENSICS_DIR.
             python -m ceph_tpu.bench_cli loadgen --smoke \
-                --seed "$seed" $LOAD_FLAGS \
+                --seed "$seed" $LOAD_FLAGS $FORENSICS_FLAGS \
                 >/dev/null 2>&1 || true
+            if [ -n "${SOAK_FORCE_FORENSICS:-}" ]; then
+                # the smoke hook dumps once, not every lap
+                FORENSICS_FLAGS="--forensics-dir $FORENSICS_DIR --slow-convergence-s $SLOW_S"
+            fi
             seed=$((seed + 1))
         done
     ) &
     LOAD_PID=$!
-    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}"
+    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)} (forensics: $FORENSICS_DIR)"
 fi
 cleanup() {
     if [ -n "$LOAD_PID" ]; then
@@ -69,6 +90,8 @@ for i in $(seq 1 "$N"); do
     if ! python -m pytest $SUITES -q -m 'not slow' \
         -p no:cacheprovider -p no:randomly; then
         echo "SOAK FAILED at iteration $i/$N"
+        echo "forensics bundles (if any): $FORENSICS_DIR"
+        ls "$FORENSICS_DIR" 2>/dev/null || true
         exit 1
     fi
 done
