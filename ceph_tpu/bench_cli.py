@@ -475,21 +475,37 @@ def _run_loadgen(args) -> tuple[float, float]:
         )
         # forensics BEFORE teardown and before any raise: wedged ops
         # are still live, the cluster log still holds this run's tail
-        if args.forensics_dir:
-            from ceph_tpu.loadgen.forensics import (
-                run_is_green,
-                write_bundle,
-            )
+        from ceph_tpu.loadgen.forensics import run_is_green
 
-            green, why = run_is_green(
-                report, args.slow_convergence_s
+        green, why = run_is_green(report, args.slow_convergence_s)
+        if "status_digest" in report:
+            # the one-line `cli status` digest (soak.sh echoes it
+            # per lap)
+            print(
+                f"status digest: {report['status_digest']}",
+                file=sys.stderr,
             )
+        if not green and report.get("pg_states") is not None:
+            # the final PG state histogram, for non-green triage
+            hist = ", ".join(
+                f"{n} {state}" for state, n in sorted(
+                    report["pg_states"].items(),
+                    key=lambda kv: (-kv[1], kv[0]),
+                )
+            ) or "(no reports)"
+            print(
+                f"final pg states ({why}): {hist}", file=sys.stderr
+            )
+        if args.forensics_dir:
+            from ceph_tpu.loadgen.forensics import write_bundle
+
             if args.force_forensics:
                 green, why = False, "forced (--force-forensics)"
             if not green:
                 manifest = write_bundle(
                     args.forensics_dir, report, reason=why,
                     trace_capture=args.trace_capture or 8,
+                    cluster=cluster,
                 )
                 report["forensics"] = manifest
                 print(

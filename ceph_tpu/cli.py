@@ -30,7 +30,6 @@ import time
 
 from ceph_tpu.cluster import Monitor, OSDDaemon, RadosClient
 from ceph_tpu.cluster.mon_store import MonStore
-from ceph_tpu.cluster.osd_daemon import SHARD_NONE
 from ceph_tpu.store import BlockStore, FileStore
 
 
@@ -312,31 +311,75 @@ def cmd_vstart(cl: Cluster, args) -> int:
     return 0
 
 
+def _flush_stats(cl: Cluster) -> None:
+    """Force a stats report from every live daemon so the status/pg
+    dump/df surfaces read fresh numbers instead of waiting a tick
+    (the CLI is one-command-and-exit)."""
+    for d in cl.daemons.values():
+        try:
+            d.report_pg_stats(force=True)
+        except Exception:
+            pass
+
+
 def cmd_status(cl: Cluster, args) -> int:
-    m = cl.mon.osdmap
-    up = sorted(m.up_osds())
-    print(f"epoch {m.epoch}")
+    """The `ceph -s` role: health digest + mon/osd census + PG state
+    histogram + client/recovery IO rates, all from the stats plane
+    (cluster/pgmap.py)."""
+    from ceph_tpu.cluster.pgmap import format_status, status_dict
+
+    _flush_stats(cl)
+    st = status_dict(cl.mon)
     if cl.n_mons > 1:
         svc = cl.mon_quorum
         live = sorted(set(range(svc.n)) - svc.dead)
-        print(
-            f"mons: {svc.n} total, quorum {live} "
+        st["mons"] = (
+            f"{svc.n} total, quorum {live} "
             f"(leader mon.{svc.leader_rank()})"
         )
-    print(f"osds: {len(m.osds)} total, {len(up)} up {up}")
-    for name, spec in sorted(m.pools.items()):
-        degraded = sum(
-            1 for pg in range(spec.pg_num)
-            if SHARD_NONE in m.pg_to_up_acting(name, pg)
+    text = format_status(st)
+    if "mons" in st:
+        text = text.replace(
+            f"    mon: epoch {st['epoch']}",
+            f"    mon: {st['mons']}, epoch {st['epoch']}",
         )
-        state = f"{degraded} degraded pgs" if degraded else "clean"
+    print(text)
+    m = cl.mon.osdmap
+    for name, spec in sorted(m.pools.items()):
         print(
-            f"pool {name!r}: id {spec.pool_id}, {spec.pg_num} pgs, "
-            f"EC {spec.k}+{spec.m} ({spec.plugin}/"
-            f"{spec.profile_name}), {state}"
+            f"    pool {name!r}: id {spec.pool_id}, {spec.pg_num} "
+            f"pgs, EC {spec.k}+{spec.m} ({spec.plugin}/"
+            f"{spec.profile_name})"
         )
     if m.pg_temp:
-        print(f"backfilling: {sorted(m.pg_temp)}")
+        print(f"    backfilling: {sorted(m.pg_temp)}")
+    return 0
+
+
+def cmd_pg_dump(cl: Cluster, args) -> int:
+    """The `ceph pg dump` role: every PG's stats row + osd stats."""
+    from ceph_tpu.cluster.pgmap import format_pg_dump
+
+    _flush_stats(cl)
+    dump = cl.mon.pgmap.pg_dump()
+    if getattr(args, "json", False):
+        print(json.dumps(dump, sort_keys=True, default=str))
+    else:
+        print(format_pg_dump(dump))
+    return 0
+
+
+def cmd_df(cl: Cluster, args) -> int:
+    """The `ceph df` role: cluster capacity + per-pool usage from
+    the stats plane's store census."""
+    from ceph_tpu.cluster.pgmap import format_df
+
+    _flush_stats(cl)
+    df = cl.mon.pgmap.df(cl.mon.osdmap)
+    if getattr(args, "json", False):
+        print(json.dumps(df, sort_keys=True))
+    else:
+        print(format_df(df))
     return 0
 
 
@@ -579,18 +622,12 @@ def cmd_health(cl: Cluster, args) -> int:
     events (slow ops, down-marks, scrub errors, peering stalls)."""
     from ceph_tpu.cluster import Manager
     from ceph_tpu.utils.cluster_log import cluster_log
-    from ceph_tpu.utils.optracker import op_tracker
 
+    _flush_stats(cl)
     report = Manager(cl.mon).health()
     print(report["status"])
     for name, check in sorted(report["checks"].items()):
         print(f"  [{check['severity'].upper()}] {name}: {check['detail']}")
-    live = op_tracker.dump_ops_in_flight()
-    slow = [op for op in live["ops"] if op["slow"]]
-    if slow:
-        print(f"  [WARN] SLOW_OPS: {len(slow)} ops in flight past "
-              "osd_op_complaint_time (dump_ops_in_flight for "
-              "timelines)")
     summary = cluster_log.summary()
     print(
         f"cluster log: {summary['events']} recent events, "
@@ -718,8 +755,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.set_defaults(fn=cmd_vstart)
 
-    sub.add_parser("status").set_defaults(fn=cmd_status)
+    sub.add_parser(
+        "status", help="the `ceph -s` shape: health + census + PG "
+        "state histogram + IO rates from the stats plane"
+    ).set_defaults(fn=cmd_status)
     sub.add_parser("osd-tree").set_defaults(fn=cmd_osd_tree)
+
+    s = sub.add_parser(
+        "pg", help="PG-stats surfaces (`pg dump`)"
+    )
+    s.add_argument("action", choices=["dump"])
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable dump")
+    s.set_defaults(fn=cmd_pg_dump)
+
+    s = sub.add_parser(
+        "df", help="cluster + per-pool capacity/usage (`ceph df`)"
+    )
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_df)
 
     s = sub.add_parser("profile-set")
     s.add_argument("name")

@@ -14,6 +14,9 @@ ops via the map (src/osdc/Objecter.cc). This package is the analog:
                 ops and the map-aware resending client.
 - ``peering``:  the explicit per-PG peering state machine
                 (PeeringState.cc analog) + crash-point injection.
+- ``pgmap``:    the stats plane — per-PG stats reports folded into
+                the PGMap aggregate (pg_stats_t / MgrStatMonitor
+                analog) behind `status` / `pg dump` / `df`.
 """
 
 from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec, SHARD_NONE
@@ -22,10 +25,14 @@ from .monitor import CommandError, Monitor
 from .objecter import IoCtx, NoPrimary, Objecter, RadosClient
 from .osd_daemon import OSDDaemon
 from .peering import PgPeeringFsm, crash_points
+from .pgmap import OSDStat, PGMap, PGStats
 from .striper import StripedIoCtx
 
 __all__ = [
     "Manager",
+    "OSDStat",
+    "PGMap",
+    "PGStats",
     "CommandError",
     "PgPeeringFsm",
     "crash_points",

@@ -16,7 +16,13 @@ three that shape cluster behavior are mirrored here as one daemon:
   autoscaler's ``warn`` mode leaves pg_num alone.
 - **health** (mon/mgr health model): one structured report merging
   down/out OSDs, degraded PGs, and autoscaler findings — the ``ceph
-  health detail`` shape.
+  health detail`` shape. PG checks are STATS-FED (monitor.pgmap, the
+  PGMap fold of primaries' reports): PG_DEGRADED carries degraded
+  object counts, PG_UNAVAILABLE reads reported ``down`` bits, and
+  PG_STUCK / OSD_NEARFULL / SLOW_OPS derive from last-clean ages,
+  osd_stat fill fractions and the optracker. The CRUSH rescan the
+  pre-stats model ran per health() call survives only as the
+  no-reports fallback for bare-monitor harnesses.
 
 The prometheus-module role is ``utils/exporter``; the mgr exposes its
 own state through the same perf-counter collection.
@@ -154,22 +160,69 @@ class Manager:
         return rows
 
     # -- health ---------------------------------------------------------
-    def health(self) -> dict:
-        """Structured health report (the mon health-check model):
-        HEALTH_OK / HEALTH_WARN / HEALTH_ERR + per-check detail."""
-        m = self.monitor.osdmap
-        checks: dict[str, dict] = {}
-        # in+down only: a permanently lost OSD the monitor already
-        # outed (and whose data re-homed) must not warn forever
-        down = sorted(
-            osd for osd, info in m.osds.items()
-            if info.in_ and not info.up
-        )
-        if down:
-            checks["OSD_DOWN"] = {
+    def _pg_checks_from_stats(self, pgmap, checks: dict) -> None:
+        """Stats-fed PG checks: the PGMap fold already carries state
+        bits and object tallies per PG, so PG_DEGRADED gains object
+        counts and PG_UNAVAILABLE reads reported ``down`` states —
+        no O(pools x pg_num x CRUSH) rescan."""
+        from ceph_tpu.utils import config as _cfg
+
+        live_pools = {
+            s.pool_id for s in self.monitor.osdmap.pools.values()
+        }
+        degraded = degraded_objects = 0
+        unavailable = []
+        for (_pid, pgid), s in pgmap.entries(live_pools):
+            if "degraded" in s.state:
+                degraded += 1
+                degraded_objects += s.degraded
+            if "down" in s.state:
+                unavailable.append((s.pool, pgid))
+        if degraded:
+            checks["PG_DEGRADED"] = {
                 "severity": "warn",
-                "detail": f"{len(down)} osds down: {down}",
+                "detail": (
+                    f"{degraded} pgs degraded "
+                    f"({degraded_objects} object copies)"
+                ),
             }
+        if unavailable:
+            checks["PG_UNAVAILABLE"] = {
+                "severity": "error",
+                "detail": (
+                    f"{len(unavailable)} pgs below k: "
+                    f"{sorted(unavailable)[:8]}"
+                ),
+            }
+        stuck = pgmap.stuck_pgs(_cfg.get("mon_pg_stuck_threshold"))
+        if stuck:
+            oldest = stuck[0]
+            checks["PG_STUCK"] = {
+                "severity": "warn",
+                "detail": (
+                    f"{len(stuck)} pgs stuck non-clean; oldest "
+                    f"{oldest['pgid']} ({oldest['state']}) for "
+                    f"{oldest['stuck_for_s']:.0f}s"
+                ),
+            }
+        nearfull = pgmap.nearfull_osds(
+            _cfg.get("mon_osd_nearfull_ratio")
+        )
+        if nearfull:
+            checks["OSD_NEARFULL"] = {
+                "severity": "warn",
+                "detail": "; ".join(
+                    f"osd.{o['osd']} at {o['fill_frac']:.0%}"
+                    for o in nearfull
+                ),
+            }
+
+    def _pg_checks_from_map(self, checks: dict) -> None:
+        """Map-rescan fallback (the pre-stats-plane model) for
+        clusters with no stats reports yet: recompute CRUSH mappings
+        and flag holes. Kept for bare-monitor harnesses — any live
+        cluster reports within one tick and takes the stats path."""
+        m = self.monitor.osdmap
         degraded = []
         unavailable = []
         for name, spec in m.pools.items():
@@ -194,6 +247,60 @@ class Manager:
                     f"{len(unavailable)} pgs below k: {unavailable[:8]}"
                 ),
             }
+
+    def _slow_ops_check(self, checks: dict) -> None:
+        """SLOW_OPS from the optracker: wedged ops surface on `cli
+        health`/`cli status` without grepping the cluster log (the
+        complaint itself is already there). Scoped to THIS cluster's
+        daemons (the map's OSDs + mon/mgr) — the reference's SLOW_OPS
+        aggregates daemon-reported ops the same way, and the process
+        tracker may carry ops of unrelated pipelines."""
+        from ceph_tpu.utils.optracker import op_tracker
+
+        daemons = {
+            f"osd.{i}" for i in self.monitor.osdmap.osds
+        } | {"mon", "mgr"}
+        live = op_tracker.dump_ops_in_flight()
+        slow = [
+            op for op in live["ops"]
+            if op["slow"] and op["daemon"] in daemons
+        ]
+        if slow:
+            oldest = max(op["age"] for op in slow)
+            checks["SLOW_OPS"] = {
+                "severity": "warn",
+                "detail": (
+                    f"{len(slow)} slow ops in flight; oldest "
+                    f"{oldest:.1f}s (dump_ops_in_flight for "
+                    "timelines)"
+                ),
+            }
+
+    def health(self) -> dict:
+        """Structured health report (the mon health-check model):
+        HEALTH_OK / HEALTH_WARN / HEALTH_ERR + per-check detail.
+        PG checks read the stats plane (monitor.pgmap) when it has
+        reports; the CRUSH rescan survives only as the no-reports
+        fallback."""
+        m = self.monitor.osdmap
+        checks: dict[str, dict] = {}
+        # in+down only: a permanently lost OSD the monitor already
+        # outed (and whose data re-homed) must not warn forever
+        down = sorted(
+            osd for osd, info in m.osds.items()
+            if info.in_ and not info.up
+        )
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "warn",
+                "detail": f"{len(down)} osds down: {down}",
+            }
+        pgmap = getattr(self.monitor, "pgmap", None)
+        if pgmap is not None and pgmap.pg:
+            self._pg_checks_from_stats(pgmap, checks)
+        else:
+            self._pg_checks_from_map(checks)
+        self._slow_ops_check(checks)
         for row in self.autoscale_status():
             if row["warn"]:
                 checks.setdefault(

@@ -54,6 +54,13 @@ class Monitor:
         pool_id_floor: int = 0,
     ) -> None:
         self.osdmap = initial or OSDMap()
+        # the stats-plane aggregate (PGMap / MgrStatMonitor role):
+        # primaries ship per-PG stats via pg_stats_report; the mgr
+        # health model, `cli status`/`pg dump`/`df` and the exporter
+        # read the fold instead of rescanning CRUSH
+        from .pgmap import PGMap
+
+        self.pgmap = PGMap()
         self._commit_fn = commit_fn
         self._clock = clock
         self._lock = threading.RLock()
@@ -613,7 +620,22 @@ class Monitor:
         with self._command():
             if name not in self.osdmap.pools:
                 raise CommandError(f"no such pool: {name!r}")
-            return self._propose(removed_pools=(name,))
+            m = self._propose(removed_pools=(name,))
+        self.pgmap.prune_pools(
+            {s.pool_id for s in m.pools.values()}
+        )
+        return m
+
+    # -- stats ingress (the MPGStats receive path) ----------------------
+    def pg_stats_report(
+        self, osd: int, epoch: int, pg_stats=(), osd_stat=None
+    ) -> int:
+        """One daemon's tick-driven stats report. Data-plane traffic:
+        folds under the PGMap's own lock, never the command lock (a
+        stats flood must not stall map commits). Returns accepted
+        per-PG records (stale reports from demoted primaries are
+        rejected inside the fold)."""
+        return self.pgmap.apply_report(osd, epoch, pg_stats, osd_stat)
 
     # -- pg_temp (the backfill serving-layout override) -----------------
     def pg_temp_set(

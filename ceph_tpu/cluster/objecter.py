@@ -172,6 +172,12 @@ class Objecter:
         self.perf = (
             _client_perf(perf_name) if perf_name is not None else None
         )
+        #: per-pool op/byte accounting (the l_osdc op_w/op_r family
+        #: sliced by pool — ROADMAP #2's per-tenant seed observable):
+        #: lazily one counter set per pool, named
+        #: ``<perf_name>.pool.<pool>`` so the exporter renders a
+        #: ``pool`` label
+        self._pool_perf: dict[str, object] = {}
         self._inflight = 0
         # cluster PSK (keyring role): all client connections sealed
         self.messenger = Messenger("client", secret=secret)
@@ -511,6 +517,45 @@ class Objecter:
             return
         self._resolve(aop, reply, None)
 
+    #: mutating client ops (per-pool write accounting); anything else
+    #: counts as a read
+    _WRITE_OPS = frozenset(
+        {"write", "writefull", "append", "truncate", "remove",
+         "rollback", "setxattr", "rmxattr", "omapset", "notify"}
+    )
+
+    def _pool_perf_for(self, pool: str):
+        with self._lock:
+            pc = self._pool_perf.get(pool)
+        if pc is not None:
+            return pc
+        from ceph_tpu.utils import PerfCountersBuilder, perf_collection
+
+        pc = (
+            PerfCountersBuilder(
+                perf_collection, f"{self.perf.name}.pool.{pool}"
+            )
+            .add_u64_counter("pool_op_w", "completed write-class ops")
+            .add_u64_counter("pool_op_r", "completed read-class ops")
+            .add_u64_counter("pool_bytes_w", "payload bytes written")
+            .add_u64_counter("pool_bytes_r", "payload bytes read")
+            .create_perf_counters()
+        )
+        with self._lock:
+            pc = self._pool_perf.setdefault(pool, pc)
+        return pc
+
+    def _pool_account(self, aop: _AsyncOp, reply) -> None:
+        pc = self._pool_perf_for(aop.pool)
+        if aop.op in self._WRITE_OPS:
+            pc.inc("pool_op_w")
+            if aop.data:
+                pc.inc("pool_bytes_w", len(aop.data))
+        else:
+            pc.inc("pool_op_r")
+            if reply is not None and reply.data:
+                pc.inc("pool_bytes_r", len(reply.data))
+
     def _resolve(self, aop: _AsyncOp, reply, error) -> None:
         if self.perf is not None:
             with self._lock:
@@ -518,6 +563,8 @@ class Objecter:
                 self.perf.set("op_inflight", self._inflight)
             self.perf.inc("op_error" if error is not None
                           else "op_completed")
+            if error is None:
+                self._pool_account(aop, reply)
         aop.tracked.finish(
             "done" if error is None
             else f"error:{type(error).__name__}"

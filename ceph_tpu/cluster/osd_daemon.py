@@ -134,7 +134,7 @@ class _CoalCtx:
     __slots__ = (
         "conn", "msg", "spec", "pgid", "epoch", "pg", "w_offset",
         "result_size", "attrs", "trunc_attrs", "done", "outcome",
-        "size",
+        "size", "trace_ctx",
     )
 
     def __init__(self, conn, msg, spec, pgid, epoch) -> None:
@@ -148,6 +148,10 @@ class _CoalCtx:
         self.result_size = 0
         self.attrs = None
         self.trunc_attrs = None
+        #: (trace_id, osd_op span id) captured at submit: later batch
+        #: phases (the writefull truncate half) re-enter this context
+        #: so their sub-op spans stay under the op's primary subtree
+        self.trace_ctx = (None, None)
         self.done: list = []
         #: ("ok", None) | ("eio", detail: recorded under the reqid)
         #: | ("exc", detail: NOT recorded — mirrors the serial path,
@@ -720,6 +724,15 @@ class OSDDaemon:
         self._scrub_lock = threading.Lock()
         #: (pool, pgid) -> (monotonic stamp, kind, n_errors, repaired)
         self.scrub_history: dict[tuple[str, int], tuple] = {}
+        # -- PG-stats reporting (the MPGStats sender): the tick ships
+        # one pg_stats record per led PG + an osd_stat to the monitor
+        # every osd_stats_report_interval seconds (0 = off)
+        self._last_stats_report = 0.0
+        self._stats_seq = 0
+        #: (map epoch, {(pool, pgid) I lead per CRUSH}) — the primary
+        #: sweep is O(pools x pg_num x CRUSH), so it recomputes only
+        #: when the epoch moves, never per report
+        self._led_cache: tuple[int, set] = (-1, set())
         # -- watch/notify soft state (osd/Watch.cc role)
         self._watch_lock = threading.Lock()
         #: (pool, loc) -> {cookie: Connection}
@@ -2486,6 +2499,7 @@ class OSDDaemon:
                         "osd_op", op=ctx.msg.op, oid=ctx.msg.oid,
                         osd=self.osd_id, tid=ctx.msg.tid,
                     ):
+                        ctx.trace_ctx = tracer.current()
                         ctx.pg.rmw.submit(
                             ctx.msg.oid, ctx.w_offset, ctx.msg.data,
                             on_commit=lambda op, c=ctx: c.done.append(op),
@@ -2510,11 +2524,17 @@ class OSDDaemon:
             for ctx in trunc:
                 ctx.done = []
                 try:
-                    ctx.pg.rmw.submit_truncate(
-                        ctx.msg.oid, len(ctx.msg.data),
-                        on_commit=lambda op, c=ctx: c.done.append(op),
-                        extra_attrs=ctx.trunc_attrs,
-                    )
+                    # re-enter the op's own osd_op context: the shrink's
+                    # sub-op spans must land under the SAME primary
+                    # subtree the write half opened (the serial path
+                    # runs both halves inside one osd_op span) — the
+                    # coalesced-path trace gap of CAPABILITIES §4b
+                    with tracer.continue_trace(*ctx.trace_ctx):
+                        ctx.pg.rmw.submit_truncate(
+                            ctx.msg.oid, len(ctx.msg.data),
+                            on_commit=lambda op, c=ctx: c.done.append(op),
+                            extra_attrs=ctx.trunc_attrs,
+                        )
                 except Exception as e:
                     ctx.outcome = ("exc", f"{type(e).__name__}: {e}")
                     live.remove(ctx)
@@ -3797,6 +3817,261 @@ class OSDDaemon:
                 pg.fsm.post_interval()
                 continue
             self._spawn_catch_up(pg, shard)
+        self.report_pg_stats()
+
+    # -- PG-stats reporting (the MPGStats sender) -----------------------
+    def report_pg_stats(self, force: bool = False) -> int:
+        """Ship one pg_stats record per PG this daemon serves as
+        primary, plus its osd_stat, to the monitor's PGMap. Driven by
+        the tick at ``osd_stats_report_interval``; ``force`` flushes
+        now regardless (the CLI surfaces call it so `status`/`pg
+        dump`/`df` read fresh numbers without waiting a tick).
+        Returns accepted records."""
+        from ceph_tpu.utils import config as _cfg
+
+        iv = _cfg.get("osd_stats_report_interval")
+        if iv <= 0 and not force:
+            return 0
+        now = time.monotonic()
+        if not force and now - self._last_stats_report < iv:
+            return 0
+        self._last_stats_report = now
+        if self._stopped:
+            return 0
+        osdmap = self.osdmap
+        self._stats_seq += 1
+        led_keys = self._map_led_pgs(osdmap)
+        # ONE store pass serves every PG's census AND the osd_stat —
+        # per-PG scans would be O(keys x pgs) per report
+        census, used, n_keys = self._stats_census(osdmap, led_keys)
+        stats = []
+        with self._pg_lock:
+            led = [
+                (key, pg) for key, pg in self._pgs.items()
+                if first_live(pg.acting) == self.osd_id
+            ]
+        covered: set[tuple[str, int]] = set()
+        for (pool, pgid), pg in led:
+            spec = osdmap.pools.get(pool)
+            if spec is None:
+                continue
+            if (pool, pgid) not in led_keys:
+                continue  # demoted: the new primary reports
+            try:
+                stats.append(self._collect_pg_stats(
+                    pool, pgid, pg, spec, osdmap,
+                    census.get((pool, pgid), {}),
+                ))
+                covered.add((pool, pgid))
+            except Exception:
+                pass  # a half-built PG must not sink the report
+        # instance-less PGs the map says I lead (idle since boot, or
+        # re-adopted after a revive without an interval change) still
+        # report — from the store census + map acting alone — so the
+        # PGMap never serves a stale record for a PG whose primary is
+        # alive (the stats-derived recovery wait keys on fresh epochs)
+        with self._pg_lock:
+            have_instance = set(self._pgs)
+        for pool, pgid in led_keys:
+            if (pool, pgid) in covered or (pool, pgid) in have_instance:
+                continue
+            spec = osdmap.pools.get(pool)
+            if spec is None:
+                continue
+            try:
+                stats.append(self._collect_idle_pg_stats(
+                    pool, pgid, spec, osdmap,
+                    census.get((pool, pgid), {}),
+                ))
+            except Exception:
+                pass
+        from .pgmap import OSDStat
+
+        cap = getattr(self.store, "device_size", 0) or _cfg.get(
+            "osd_device_capacity_bytes"
+        )
+        osd_stat = OSDStat(
+            osd=self.osd_id, used_bytes=used,
+            capacity_bytes=int(cap), num_objects=n_keys,
+        )
+        try:
+            return self.monitor.pg_stats_report(
+                self.osd_id, osdmap.epoch, stats, osd_stat
+            )
+        except Exception:
+            return 0  # a mon hiccup must not kill the tick loop
+
+    def _map_led_pgs(self, osdmap: OSDMap) -> set:
+        """{(pool, pgid) whose CRUSH primary I am}, cached per map
+        epoch — the primary sweep must not run per report."""
+        epoch, cached = self._led_cache
+        if epoch == osdmap.epoch:
+            return cached
+        led = {
+            (pool, pgid)
+            for pool, spec in osdmap.pools.items()
+            for pgid in range(spec.pg_num)
+            if osdmap.pg_primary(pool, pgid) == self.osd_id
+        }
+        self._led_cache = (osdmap.epoch, led)
+        return led
+
+    def _stats_census(
+        self, osdmap: OSDMap, led_keys: set
+    ) -> tuple[dict, int, int]:
+        """One pass over my store: ({(pool, pgid) -> {loc: logical
+        size}} for the PGs in ``led_keys``, used bytes, key count).
+        Logical sizes come from the OI attr (the object_info_t size),
+        shard bytes from stat; keys of PGs led elsewhere only feed
+        the used-bytes total."""
+        from ceph_tpu.placement import stable_hash
+
+        by_id = {
+            spec.pool_id: (pool, spec)
+            for pool, spec in osdmap.pools.items()
+        }
+        census: dict[tuple[str, int], dict[str, int]] = {}
+        used = 0
+        keys = self.store.list_objects()
+        for key in keys:
+            try:
+                used += self.store.stat(key)
+            except (FileNotFoundError, OSError):
+                pass
+            try:
+                loc, _si = split_shard_key(key)
+                pool_id, oid = split_loc(loc)
+            except ValueError:
+                continue
+            entry = by_id.get(pool_id)
+            if entry is None:
+                continue
+            pool, spec = entry
+            pgid = stable_hash(
+                str(pool_id), head_of_loc(oid)
+            ) % spec.pg_num
+            if (pool, pgid) not in led_keys:
+                continue
+            sized = census.setdefault((pool, pgid), {})
+            if loc in sized:
+                continue
+            try:
+                size, _ev = parse_oi(self.store.getattr(key, OI_KEY))
+            except (FileNotFoundError, KeyError, ValueError):
+                size = 0
+            sized[loc] = size
+        return census, used, len(keys)
+
+    def _collect_pg_stats(
+        self, pool: str, pgid: int, pg: _PG, spec, osdmap: OSDMap,
+        sized: "dict[str, int]",
+    ):
+        """One pg_stats_t record from live primary state + the shared
+        store census (``sized``: loc -> logical size for this PG):
+        state bits, object/byte counts, degraded/misplaced tallies,
+        and the cumulative client/recovery counters the PGMap cuts
+        rates from."""
+        from .pgmap import PGStats
+
+        acting = tuple(pg.acting)
+        holes = {i for i, o in enumerate(acting) if o == SHARD_NONE}
+        recovering = set(pg.backend.recovering) - holes
+        degraded_pos = holes | recovering
+        live = len(acting) - len(holes)
+        peered = pg.peered.is_set()
+        backfilling = bool(pg.backfilling) or (
+            (pool, pgid) in osdmap.pg_temp
+        )
+        states = []
+        if not peered:
+            states.append("peering")
+        elif live < spec.k:
+            states.append("down")
+        else:
+            states.append("active")
+        if holes:
+            states.append("undersized")
+        if degraded_pos:
+            states.append("degraded")
+        if recovering:
+            states.append("recovering")
+        if backfilling:
+            states.append("backfilling")
+        if (
+            peered and live >= spec.k and not degraded_pos
+            and not backfilling
+        ):
+            states.append("clean")
+        # object/byte census from my own shard keys (the primary
+        # holds one shard of every object it leads; OI attrs carry
+        # the logical size — no peer IO, no pipeline locks)
+        n_obj = len(sized)
+        n_bytes = sum(sized.values())
+        misplaced = 0
+        if (pool, pgid) in osdmap.pg_temp:
+            target = osdmap.pg_to_raw(pool, pgid, ignore_temp=True)
+            moved = sum(
+                1 for a, t in zip(acting, target) if a != t
+            )
+            misplaced = n_obj * moved
+        rmw = pg.rmw.perf
+        reads = pg.reads.perf
+        rec = pg.recovery.perf
+        return PGStats(
+            pool=pool,
+            pool_id=spec.pool_id,
+            pgid=pgid,
+            state=tuple(sorted(states)),
+            up=tuple(osdmap.pg_to_raw(pool, pgid)),
+            acting=acting,
+            num_objects=n_obj,
+            num_bytes=n_bytes,
+            degraded=n_obj * len(degraded_pos),
+            misplaced=misplaced,
+            log_size=len(pg.pglog.entries),
+            client_write_ops=rmw.get("write_ops"),
+            client_write_bytes=rmw.get("write_bytes"),
+            client_read_ops=reads.get("read_ops"),
+            client_read_bytes=reads.get("read_bytes"),
+            recovery_ops=rec.get("recovery_ops"),
+            recovery_bytes=rec.get("recovered_bytes"),
+            reported_epoch=osdmap.epoch,
+            reported_seq=self._stats_seq,
+            primary=self.osd_id,
+        )
+
+    def _collect_idle_pg_stats(
+        self, pool: str, pgid: int, spec, osdmap: OSDMap,
+        sized: "dict[str, int]",
+    ):
+        """A pg_stats record for a PG I lead per the map but hold no
+        live instance for (no client IO this interval): state from
+        the map acting set, census from the shared store pass, zero
+        IO counters."""
+        from .pgmap import PGStats
+
+        acting = tuple(osdmap.pg_to_up_acting(pool, pgid))
+        holes = sum(1 for o in acting if o == SHARD_NONE)
+        live = len(acting) - holes
+        states = ["active"] if live >= spec.k else ["down"]
+        if holes:
+            states += ["undersized", "degraded"]
+        elif live >= spec.k:
+            states.append("clean")
+        return PGStats(
+            pool=pool,
+            pool_id=spec.pool_id,
+            pgid=pgid,
+            state=tuple(sorted(states)),
+            up=tuple(osdmap.pg_to_raw(pool, pgid)),
+            acting=acting,
+            num_objects=len(sized),
+            num_bytes=sum(sized.values()),
+            degraded=len(sized) * holes,
+            reported_epoch=osdmap.epoch,
+            reported_seq=self._stats_seq,
+            primary=self.osd_id,
+        )
 
     # -- background scrub scheduler (osd/scrubber/osd_scrub.cc role) ----
     def _scrub_due(

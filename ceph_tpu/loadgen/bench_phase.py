@@ -35,6 +35,12 @@ tracked/untracked, acceptance < 0.02) — proving the always-on
 plane (TrackedOp registration + event marks across objecter, RMW
 and sub-op layers) is cheap enough to leave on.
 
+Round 15 adds the stats-plane A/B the same way: reports on vs
+``osd_stats_report_interval=0`` (``cluster_gbps_stats_on`` /
+``cluster_gbps_stats_off`` / ``stats_report_overhead_frac`` = 1 −
+on/off, acceptance < 0.01) — the PG-stats pipeline's cost on the
+smallop-heavy serving path.
+
 Sized by ``CEPH_TPU_BENCH_CLUSTER_OPS`` (default 240 ops at queue
 depth ``CEPH_TPU_BENCH_CLUSTER_QD`` = 32 over
 ``CEPH_TPU_BENCH_CLUSTER_OBJECTS`` = 256 objects of 256 KiB; tunnel
@@ -214,6 +220,21 @@ def measure_cluster(result: dict, enc_gbps: float) -> None:
     if untracked["gbps"]:
         result["trace_overhead_frac"] = round(
             max(1.0 - tracked["gbps"] / untracked["gbps"], 0.0), 6
+        )
+
+    # -- A/B: stats reporting on vs off (round-15 stats plane) — the
+    # SAME seed and sizing with `osd_stats_report_interval=0` as the
+    # off arm, pinning what the tick-driven PG-stats pipeline (store
+    # census + report fold + rate rings) costs the serving path.
+    # stats_report_overhead_frac = 1 - on/off; acceptance < 0.01.
+    stats_on = _leg(scale_ops, qd, max_objects, seed=0x57A75)
+    with config.override(osd_stats_report_interval=0.0):
+        stats_off = _leg(scale_ops, qd, max_objects, seed=0x57A75)
+    result["cluster_gbps_stats_on"] = stats_on["gbps"]
+    result["cluster_gbps_stats_off"] = stats_off["gbps"]
+    if stats_off["gbps"]:
+        result["stats_report_overhead_frac"] = round(
+            max(1.0 - stats_on["gbps"] / stats_off["gbps"], 0.0), 6
         )
 
     # -- scaling rows: GB/s and IOPS vs OSD count, then vs chip count

@@ -293,6 +293,46 @@ class LoadCluster:
             time.sleep(0.05)
         return self.is_recovered()
 
+    # -- stats-plane recovery observation (round 15) --------------------
+    @property
+    def pgmap(self):
+        """The monitor-side PGMap aggregate the stats plane folds
+        primaries' reports into (cluster/pgmap.py)."""
+        return self.mon.pgmap
+
+    def is_recovered_stats(self, min_epoch: int = 0) -> bool:
+        """Recovery as the STATS PLANE sees it: every reported PG of
+        the pool is clean with zero degraded object copies, reported
+        at/after ``min_epoch`` (pass the post-revive map epoch so a
+        dead primary's stale clean report cannot fake convergence).
+        PGs with no report yet (never instantiated — no data) don't
+        block; any degraded data forces a report via peering."""
+        if self.dead:
+            return False
+        spec = self.mon.osdmap.pools[self.pool]
+        pgmap = self.pgmap
+        seen = 0
+        for pgid in range(spec.pg_num):
+            s = pgmap.get(spec.pool_id, pgid)
+            if s is None:
+                continue
+            if s.reported_epoch < min_epoch:
+                return False
+            if s.degraded or "clean" not in s.state:
+                return False
+            seen += 1
+        return seen > 0
+
+    def wait_recovered_stats(
+        self, timeout: float = 60.0, min_epoch: int = 0
+    ) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.is_recovered_stats(min_epoch):
+                return True
+            time.sleep(0.05)
+        return self.is_recovered_stats(min_epoch)
+
     def scrub_clean(self, repair: bool = True) -> bool:
         """Primary-driven scrub sweep; True iff no object reported
         errors (after optional repair — the post-thrash convergence

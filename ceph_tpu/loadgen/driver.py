@@ -622,6 +622,26 @@ class LoadGenerator:
         if self.faults is not None:
             report["fault"] = self.faults.metrics(self.recorder)
             report["recovered"] = self.cluster.is_recovered()
+        # stats-plane snapshot: the final PG state histogram + the
+        # one-line `cli status` digest (soak laps log it; bench_cli
+        # prints it on non-green runs)
+        mon = getattr(self.cluster, "mon", None)
+        if mon is not None and getattr(mon, "pgmap", None) is not None:
+            try:
+                for d in self.cluster.daemons.values():
+                    if d.osd_id not in self.cluster.dead:
+                        d.report_pg_stats(force=True)
+                from ceph_tpu.cluster.pgmap import (
+                    status_dict,
+                    status_digest,
+                )
+
+                st = status_dict(mon)
+                report["pg_states"] = st["pgs"]["histogram"]
+                report["degraded_objects"] = st["degraded_objects"]
+                report["status_digest"] = status_digest(st)
+            except Exception:
+                pass  # observability must not redden a green run
         if self.spec.trace_capture:
             # the N slowest assembled traces of the run (span trees +
             # critical paths + Chrome trace JSON): the in-process

@@ -78,7 +78,13 @@ class FaultSchedule:
         self._next = 0
         self.kill_at: float | None = None      # monotonic stamps
         self.revive_at: float | None = None
+        #: stats-plane convergence stamp (degraded-object count back
+        #: to zero in the PGMap fold) — the PRIMARY time_to_recovered
+        #: derivation since round 15
         self.recovered_at: float | None = None
+        #: the bespoke direct-state poll's stamp, kept beside the
+        #: stats one so the two derivations stay cross-checkable
+        self.recovered_legacy_at: float | None = None
         self.dcn_killed_at: float | None = None
         self.killed: list[int] = []
         self._net_armed = False
@@ -156,8 +162,13 @@ class FaultSchedule:
 
     def settle(self, cluster) -> None:
         """Post-run: heal any armed link faults/partitions, revive
-        anything still dead, then wait for the cluster to report
-        recovered, stamping ``recovered_at``."""
+        anything still dead, then wait for convergence TWICE — the
+        legacy direct-state poll (``recovered_legacy_at``), then the
+        stats plane (``recovered_at``: every PG's report clean with
+        zero degraded object copies at a post-revive epoch). The
+        stats stamp is the one ``time_to_recovered_s`` is cut from;
+        the two must agree within about one report interval (pinned
+        by the tier-1 stats-plane smoke)."""
         if self._net_armed:
             cluster.net_heal()
             self._net_armed = False
@@ -167,8 +178,21 @@ class FaultSchedule:
             cluster.revive(osd)
             self.killed.remove(osd)
             self.revive_at = time.monotonic()
+        # post-revive epoch floor: stale clean reports from before the
+        # fault carry older epochs and cannot fake convergence
+        min_epoch = cluster.mon.osdmap.epoch
+        deadline = time.monotonic() + self.recovery_timeout
         if cluster.wait_recovered(self.recovery_timeout):
-            self.recovered_at = time.monotonic()
+            self.recovered_legacy_at = time.monotonic()
+        wait_stats = getattr(cluster, "wait_recovered_stats", None)
+        if wait_stats is not None:
+            if wait_stats(
+                max(deadline - time.monotonic(), 1.0),
+                min_epoch=min_epoch,
+            ):
+                self.recovered_at = time.monotonic()
+        else:  # stats-blind harness: the legacy stamp stands alone
+            self.recovered_at = self.recovered_legacy_at
 
     @classmethod
     def primary_kill(
@@ -260,7 +284,11 @@ class FaultSchedule:
         )
 
     def metrics(self, recorder) -> dict:
-        """Degraded-window throughput + time-to-recovered rows."""
+        """Degraded-window throughput + time-to-recovered rows.
+        ``time_to_recovered_s`` derives from the STATS PLANE
+        (degraded-object count back to zero in the PGMap);
+        ``time_to_recovered_legacy_s`` keeps the direct-state poll
+        beside it for cross-checking."""
         out: dict = {}
         if self.kill_at is None:
             return out
@@ -269,8 +297,13 @@ class FaultSchedule:
             recorder.window_gbps(self.kill_at, t_end), 6
         )
         out["degraded_window_s"] = round(t_end - self.kill_at, 3)
-        if self.revive_at is not None and self.recovered_at is not None:
-            out["time_to_recovered_s"] = round(
-                self.recovered_at - self.revive_at, 3
-            )
+        if self.revive_at is not None:
+            if self.recovered_at is not None:
+                out["time_to_recovered_s"] = round(
+                    self.recovered_at - self.revive_at, 3
+                )
+            if self.recovered_legacy_at is not None:
+                out["time_to_recovered_legacy_s"] = round(
+                    self.recovered_legacy_at - self.revive_at, 3
+                )
         return out

@@ -19,6 +19,9 @@ wedged ops are still live:
                            arms, crash-point fires)
 - ``perf_dump.json``       the full perf-counter collection
 - ``report.json``          the run report that triggered the dump
+- ``status.json``          the `ceph -s` snapshot from the stats
+                           plane (when a cluster is passed in)
+- ``pg_dump.json``         every PG's stats row (`ceph pg dump`)
 - ``MANIFEST.json``        reason + file list
 
 ``tools/soak.sh`` arms this via ``bench_cli loadgen --forensics-dir``
@@ -70,10 +73,15 @@ def write_bundle(
     report: "dict | None" = None,
     reason: str = "",
     trace_capture: int = 8,
+    cluster=None,
 ) -> dict:
     """Write the forensics bundle under ``out_dir/<stamp>/``; returns
     the manifest (with ``dir`` pointing at the bundle).  Never raises
-    past best effort — forensics must not turn a red run redder."""
+    past best effort — forensics must not turn a red run redder.
+    With ``cluster`` (a LoadCluster), the bundle also captures the
+    stats plane: ``status.json`` (the `ceph -s` shape) and
+    ``pg_dump.json`` (every PG's stats row) — the aggregate view a
+    wedged run is triaged from."""
     from ceph_tpu.utils.cluster_log import cluster_log
     from ceph_tpu.utils.optracker import op_tracker
     from ceph_tpu.utils.perf_counters import perf_collection
@@ -107,6 +115,18 @@ def write_bundle(
     dump("perf_dump.json", perf_collection.dump())
     if report is not None:
         dump("report.json", report)
+    mon = getattr(cluster, "mon", None)
+    if mon is not None and getattr(mon, "pgmap", None) is not None:
+        try:
+            from ceph_tpu.cluster.pgmap import status_dict
+
+            for d in cluster.daemons.values():
+                if d.osd_id not in cluster.dead:
+                    d.report_pg_stats(force=True)
+            dump("status.json", status_dict(mon))
+            dump("pg_dump.json", mon.pgmap.pg_dump())
+        except Exception:
+            pass
     manifest = {
         "reason": reason,
         "stamp": stamp,

@@ -123,6 +123,18 @@ def _register_builtins(sock: AdminSocket) -> None:
         lambda name=None: perf_collection.reset(name),
         "zero one named counter set, or all of them",
     )
+    def _pgmap_dump():
+        from ceph_tpu.cluster.pgmap import current_pgmap
+
+        pgmap = current_pgmap()
+        return pgmap.dump() if pgmap is not None else {}
+
+    sock.register(
+        "pgmap", _pgmap_dump,
+        "the PGMap aggregate (per-PG stats, pool/cluster totals, "
+        "state histogram, windowed IO/recovery rates)",
+    )
+
     sock.register(
         "log last",
         lambda n=20, daemon=None, severity=None: cluster_log.last(

@@ -16,7 +16,11 @@ pipeline/daemon counter set registers there) the same way:
 
 Metric name = ``ceph_tpu_<key>``; the owning counter-set's name rides
 in a ``set`` label (the reference labels by daemon the same way, e.g.
-``ceph_osd_op_w{ceph_daemon="osd.0"}``). The server is a stdlib
+``ceph_osd_op_w{ceph_daemon="osd.0"}``). Set names containing a
+``.pool.<name>`` segment split into a ``set`` + ``pool`` label pair
+(``objecter.pool.mypool`` -> ``set="objecter",pool="mypool"``), so
+per-pool accounting — the objecter's per-pool op/byte sets, the
+PGMap's per-pool gauges — lands as a proper Prometheus dimension. The server is a stdlib
 ThreadingHTTPServer on a background thread serving ``/metrics`` —
 curl-able in a vstart cluster (``ceph_tpu.cli vstart --exporter``).
 """
@@ -60,7 +64,18 @@ def render_exposition(
         entry[1].append((labels, value))
 
     for set_name, (schema, dump) in coll.snapshot().items():
-        label = f'set="{_escape_label(set_name)}"'
+        # a trailing ".pool.<name>" segment becomes a pool label —
+        # only when <name> is the final dot-free component, so the
+        # per-PG pipeline sets ("osd.0.<pool>.<pg>.rmw", where a pool
+        # may legitimately be NAMED "pool") keep their plain label
+        base, sep, pool = set_name.rpartition(".pool.")
+        if sep and pool and "." not in pool:
+            label = (
+                f'pool="{_escape_label(pool)}",'
+                f'set="{_escape_label(base)}"'
+            )
+        else:
+            label = f'set="{_escape_label(set_name)}"'
         for key, spec in schema.items():
             metric = f"{_PREFIX}_{_sanitize(key)}"
             v = dump[key]

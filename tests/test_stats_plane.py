@@ -1,0 +1,559 @@
+"""Cluster-wide stats plane (round 15): PG-stats reports folded into
+the PGMap aggregate, stale-report rejection, windowed IO/recovery
+rates, stats-fed mgr health checks (PG_DEGRADED with object counts,
+PG_STUCK, OSD_NEARFULL, SLOW_OPS), the `status`/`pg dump`/`df`
+surfaces, and the live deterministic-seed smoke pinning the ISSUE-12
+acceptance: degraded object counts rise on a primary kill, recovery
+rates go nonzero, everything returns to clean, and the stats-derived
+``time_to_recovered_s`` agrees with the legacy direct-state poll
+within about one report interval.
+"""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Manager, Monitor
+from ceph_tpu.cluster.pgmap import (
+    OSDStat,
+    PGMap,
+    PGStats,
+    format_df,
+    format_pg_dump,
+    format_status,
+    status_dict,
+    status_digest,
+)
+from ceph_tpu.utils import config
+from ceph_tpu.utils.optracker import op_tracker
+
+
+def mkstats(
+    pool="p1",
+    pool_id=1,
+    pgid=0,
+    state=("active", "clean"),
+    epoch=5,
+    seq=1,
+    primary=0,
+    **kw,
+):
+    return PGStats(
+        pool=pool, pool_id=pool_id, pgid=pgid,
+        state=tuple(sorted(state)), reported_epoch=epoch,
+        reported_seq=seq, primary=primary, **kw,
+    )
+
+
+class _FakeClock:
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestPGMapFold:
+    def test_totals_histogram_and_pools(self):
+        pm = PGMap()
+        pm.apply_report(0, 5, [
+            mkstats(pgid=0, num_objects=4, num_bytes=4096),
+            mkstats(pgid=1, state=("active", "degraded"),
+                    num_objects=2, num_bytes=1024, degraded=2),
+        ])
+        pm.apply_report(1, 5, [
+            mkstats(pgid=2, primary=1, num_objects=1, num_bytes=512),
+        ], OSDStat(osd=1, used_bytes=100, capacity_bytes=1000))
+        t = pm.totals()
+        assert t["pgs"] == 3
+        assert t["objects"] == 7
+        assert t["bytes"] == 4096 + 1024 + 512
+        assert t["degraded_objects"] == 2
+        assert t["pgs_degraded"] == 1
+        assert t["pgs_clean"] == 2
+        assert t["osd_used_bytes"] == 100
+        hist = pm.state_histogram()
+        assert hist["active+clean"] == 2
+        assert hist["active+degraded"] == 1
+        pools = pm.pool_totals()
+        assert pools["p1"]["pgs"] == 3
+        assert pools["p1"]["objects"] == 7
+
+    def test_stale_report_rejected_by_epoch(self):
+        """The acceptance scenario: a demoted primary's report (older
+        reported epoch) is rejected once the takeover primary has
+        reported at the newer epoch."""
+        pm = PGMap()
+        assert pm.apply_report(0, 5, [mkstats(epoch=5, primary=0)]) == 1
+        # takeover: osd.3 reports at the post-failover epoch
+        assert pm.apply_report(
+            3, 7, [mkstats(epoch=7, primary=3, num_objects=9)]
+        ) == 1
+        # the demoted primary retries with its stale interval
+        assert pm.apply_report(
+            0, 5, [mkstats(epoch=5, seq=2, primary=0)]
+        ) == 0
+        s = pm.get(1, 0)
+        assert s.primary == 3 and s.num_objects == 9
+        from ceph_tpu.utils.perf_counters import perf_collection
+
+        dump = perf_collection.dump()["pgmap"]
+        assert dump["reports_rejected"] >= 1
+
+    def test_same_epoch_second_claimant_rejected(self):
+        pm = PGMap()
+        pm.apply_report(0, 5, [mkstats(epoch=5, primary=0)])
+        assert pm.apply_report(
+            2, 5, [mkstats(epoch=5, primary=2)]
+        ) == 0
+        assert pm.get(1, 0).primary == 0
+
+    def test_seq_regression_same_primary_rejected(self):
+        pm = PGMap()
+        pm.apply_report(0, 5, [mkstats(epoch=5, seq=8)])
+        assert pm.apply_report(0, 5, [mkstats(epoch=5, seq=7)]) == 0
+        assert pm.apply_report(0, 5, [mkstats(epoch=5, seq=9)]) == 1
+
+    def test_rates_from_successive_deltas(self):
+        clock = _FakeClock()
+        pm = PGMap(clock=clock)
+        pm.apply_report(0, 5, [mkstats(
+            client_write_bytes=0, client_write_ops=0,
+        )])
+        clock.t += 2.0
+        pm.apply_report(0, 5, [mkstats(
+            seq=2, client_write_bytes=2000, client_write_ops=10,
+            recovery_bytes=500, recovery_ops=2,
+        )])
+        r = pm.rates(window=10.0)
+        assert r["client_write_bps"] == pytest.approx(1000.0)
+        assert r["client_write_iops"] == pytest.approx(5.0)
+        assert r["recovery_bps"] == pytest.approx(250.0)
+        assert r["recovery_ops_per_s"] == pytest.approx(1.0)
+
+    def test_negative_delta_clamps_to_zero(self):
+        """A primary takeover resets cumulative counters; the rate
+        window must clamp, not go negative."""
+        clock = _FakeClock()
+        pm = PGMap(clock=clock)
+        pm.apply_report(0, 5, [mkstats(client_write_bytes=9000)])
+        clock.t += 1.0
+        pm.apply_report(3, 7, [mkstats(
+            epoch=7, primary=3, client_write_bytes=100,
+        )])
+        r = pm.rates(window=10.0)
+        assert r["client_write_bps"] == 0.0
+
+    def test_stuck_pg_ages_from_last_clean(self):
+        clock = _FakeClock()
+        pm = PGMap(clock=clock)
+        pm.apply_report(0, 5, [mkstats(
+            state=("active", "degraded"), degraded=3,
+        )])
+        assert pm.stuck_pgs(30.0) == []
+        clock.t += 40.0
+        stuck = pm.stuck_pgs(30.0)
+        assert len(stuck) == 1
+        assert stuck[0]["pgid"] == "p1/0"
+        assert stuck[0]["stuck_for_s"] == pytest.approx(40.0)
+        # a clean report resets the age
+        pm.apply_report(0, 6, [mkstats(epoch=6, seq=2)])
+        assert pm.stuck_pgs(30.0) == []
+
+    def test_nearfull_osds(self):
+        pm = PGMap()
+        pm.apply_report(
+            0, 5, [], OSDStat(osd=0, used_bytes=90, capacity_bytes=100)
+        )
+        pm.apply_report(
+            1, 5, [], OSDStat(osd=1, used_bytes=10, capacity_bytes=100)
+        )
+        near = pm.nearfull_osds(0.85)
+        assert [o["osd"] for o in near] == [0]
+
+    def test_prune_pools(self):
+        pm = PGMap()
+        pm.apply_report(0, 5, [mkstats(pool="a", pool_id=1),
+                               mkstats(pool="b", pool_id=2)])
+        pm.prune_pools({2})
+        assert pm.get(1, 0) is None
+        assert pm.get(2, 0) is not None
+
+    def test_degraded_transitions_land_in_cluster_log(self):
+        from ceph_tpu.utils.cluster_log import cluster_log
+
+        cluster_log.clear()
+        pm = PGMap()
+        pm.apply_report(0, 5, [mkstats(
+            pool="tlog", state=("active", "degraded"), degraded=4,
+        )])
+        pm.apply_report(0, 6, [mkstats(pool="tlog", epoch=6, seq=2)])
+        events = [
+            e for e in cluster_log.last(50, daemon="mgr")
+            if "tlog/0" in e["message"]
+        ]
+        kinds = [e["type"] for e in events]
+        assert "pg_degraded" in kinds and "pg_clean" in kinds
+        deg = next(e for e in events if e["type"] == "pg_degraded")
+        assert deg["severity"] == "WRN"
+        assert "4 degraded object copies" in deg["message"]
+
+
+def mkmon(n=6, pools=(("p1", 8, 2, 1),)):
+    mon = Monitor()
+    for i in range(n):
+        mon.osd_crush_add(i, zone=f"z{i % 3}")
+        mon.osd_boot(i, ("127.0.0.1", 7000 + i))
+    for name, pgs, k, m in pools:
+        prof = f"prof_{name}"
+        mon.osd_erasure_code_profile_set(
+            prof, {"plugin": "isa", "k": str(k), "m": str(m)}
+        )
+        mon.osd_pool_create(name, pgs, prof)
+    return mon
+
+
+class TestStatsFedHealth:
+    def test_pg_degraded_gains_object_counts(self):
+        mon = mkmon()
+        spec = mon.osdmap.pools["p1"]
+        mon.pgmap.apply_report(0, mon.osdmap.epoch, [mkstats(
+            pool="p1", pool_id=spec.pool_id, pgid=0,
+            state=("active", "degraded", "undersized"),
+            num_objects=6, degraded=6, epoch=mon.osdmap.epoch,
+        )])
+        checks = Manager(mon).health()["checks"]
+        assert "PG_DEGRADED" in checks
+        assert "6 object copies" in checks["PG_DEGRADED"]["detail"]
+
+    def test_pg_unavailable_from_down_state(self):
+        mon = mkmon()
+        spec = mon.osdmap.pools["p1"]
+        mon.pgmap.apply_report(0, mon.osdmap.epoch, [mkstats(
+            pool="p1", pool_id=spec.pool_id, pgid=3,
+            state=("down", "undersized", "degraded"),
+            epoch=mon.osdmap.epoch,
+        )])
+        report = Manager(mon).health()
+        assert report["status"] == "HEALTH_ERR"
+        assert "PG_UNAVAILABLE" in report["checks"]
+
+    def test_pg_stuck_check(self):
+        mon = mkmon()
+        clock = _FakeClock()
+        mon.pgmap = PGMap(clock=clock)  # swap in a steerable clock
+        spec = mon.osdmap.pools["p1"]
+        mon.pgmap.apply_report(0, mon.osdmap.epoch, [mkstats(
+            pool="p1", pool_id=spec.pool_id, pgid=1,
+            state=("active", "degraded"), epoch=mon.osdmap.epoch,
+        )])
+        clock.t += 100.0
+        with config.override(mon_pg_stuck_threshold=60.0):
+            checks = Manager(mon).health()["checks"]
+        assert "PG_STUCK" in checks
+        assert "p1/1" in checks["PG_STUCK"]["detail"]
+
+    def test_osd_nearfull_check(self):
+        mon = mkmon()
+        spec = mon.osdmap.pools["p1"]
+        mon.pgmap.apply_report(0, mon.osdmap.epoch, [mkstats(
+            pool="p1", pool_id=spec.pool_id,
+            epoch=mon.osdmap.epoch,
+        )], OSDStat(osd=2, used_bytes=95, capacity_bytes=100))
+        checks = Manager(mon).health()["checks"]
+        assert "OSD_NEARFULL" in checks
+        assert "osd.2" in checks["OSD_NEARFULL"]["detail"]
+
+    def test_slow_ops_check(self):
+        mon = mkmon()
+        with config.override(osd_op_complaint_time=0.05):
+            # osd.3 is in the map: the check scopes to the cluster's
+            # own daemons (unrelated pipelines' ops don't count)
+            top = op_tracker.register(
+                "rmw_write", daemon="osd.3", oid="stuckobj"
+            )
+            try:
+                deadline = time.monotonic() + 5.0
+                while not top.slow and time.monotonic() < deadline:
+                    op_tracker.poke()
+                    time.sleep(0.02)
+                assert top.slow
+                checks = Manager(mon).health()["checks"]
+                assert "SLOW_OPS" in checks
+                assert "slow ops in flight" in (
+                    checks["SLOW_OPS"]["detail"]
+                )
+            finally:
+                top.finish()
+        assert "SLOW_OPS" not in Manager(mon).health()["checks"]
+
+    def test_slow_ops_scoped_to_cluster_daemons(self):
+        """A slow op of an unrelated pipeline (not a map daemon) must
+        not poison this cluster's health."""
+        mon = mkmon()
+        with config.override(osd_op_complaint_time=0.05):
+            top = op_tracker.register(
+                "rmw_write", daemon="some_pipeline", oid="elsewhere"
+            )
+            try:
+                deadline = time.monotonic() + 5.0
+                while not top.slow and time.monotonic() < deadline:
+                    op_tracker.poke()
+                    time.sleep(0.02)
+                assert top.slow
+                assert "SLOW_OPS" not in (
+                    Manager(mon).health()["checks"]
+                )
+            finally:
+                top.finish()
+
+    def test_fallback_to_map_scan_without_reports(self):
+        """A bare monitor (no daemons, no reports) keeps the legacy
+        CRUSH-rescan checks."""
+        mon = mkmon(n=3, pools=[("p1", 8, 2, 1)])
+        mon.pgmap.pg.clear()
+        mon.osd_down(0)
+        checks = Manager(mon).health()["checks"]
+        assert "OSD_DOWN" in checks
+        assert "PG_DEGRADED" in checks or "PG_UNAVAILABLE" in checks
+
+
+class TestSurfaces:
+    def _reported_mon(self):
+        mon = mkmon()
+        spec = mon.osdmap.pools["p1"]
+        for pgid in range(spec.pg_num):
+            mon.pg_stats_report(0, mon.osdmap.epoch, [mkstats(
+                pool="p1", pool_id=spec.pool_id, pgid=pgid,
+                num_objects=2, num_bytes=2048,
+                epoch=mon.osdmap.epoch,
+            )], OSDStat(osd=0, used_bytes=4096,
+                        capacity_bytes=1 << 20))
+        return mon
+
+    def test_status_dict_and_format(self):
+        mon = self._reported_mon()
+        st = status_dict(mon)
+        assert st["pgs"]["total"] == 8
+        assert st["pgs"]["histogram"]["active+clean"] == 8
+        assert st["pgs"]["unreported"] == 0
+        assert st["objects"] == 16
+        text = format_status(st)
+        assert "8 active+clean" in text
+        assert "health:" in text and "osd: 6 total" in text
+        digest = status_digest(st)
+        assert "\n" not in digest
+        assert "8 active+clean" in digest
+
+    def test_pg_dump_and_df_render(self):
+        mon = self._reported_mon()
+        dump = mon.pgmap.pg_dump()
+        assert len(dump["pg_stats"]) == 8
+        text = format_pg_dump(dump)
+        assert "p1/0" in text and "active+clean" in text
+        df = mon.pgmap.df(mon.osdmap)
+        assert df["pools"]["p1"]["objects"] == 16
+        # EC 2+1 raw estimate = stored * 3/2
+        assert df["pools"]["p1"]["raw_bytes_est"] == (
+            df["pools"]["p1"]["stored_bytes"] * 3 // 2
+        )
+        assert "CLUSTER:" in format_df(df)
+        json.dumps(df)  # CLI --json contract
+
+    def test_admin_socket_pgmap_dump(self):
+        from ceph_tpu.utils.admin_socket import admin_socket
+
+        mon = self._reported_mon()
+        dump = admin_socket.execute("pgmap")
+        assert dump["totals"]["pgs"] == 8
+        assert dump["version"] == mon.pgmap.version
+
+
+class TestExporterPoolLabels:
+    def test_pgmap_and_pool_sets_render(self):
+        from ceph_tpu.utils.exporter import render_exposition
+        from ceph_tpu.utils.perf_counters import perf_collection
+
+        mon = mkmon()
+        spec = mon.osdmap.pools["p1"]
+        mon.pg_stats_report(0, mon.osdmap.epoch, [mkstats(
+            pool="p1", pool_id=spec.pool_id, num_objects=3,
+            num_bytes=300, epoch=mon.osdmap.epoch,
+        )])
+        text = render_exposition(perf_collection)
+        assert 'ceph_tpu_pgs{set="pgmap"}' in text
+        # per-pool gauges carry the pool label
+        assert (
+            'ceph_tpu_pool_objects{pool="p1",set="pgmap"} 3' in text
+        )
+
+    def test_objecter_per_pool_accounting(self):
+        """The ROADMAP-#2 seed observable: client op/byte counters
+        sliced by pool on the objecter perf set, pool-labelled on the
+        exporter."""
+        from ceph_tpu.loadgen import LoadCluster
+        from ceph_tpu.utils.exporter import render_exposition
+        from ceph_tpu.utils.perf_counters import perf_collection
+
+        cluster = LoadCluster(
+            n_osds=4, k=2, m=1, pg_num=4, chunk_size=1024,
+        )
+        try:
+            cluster.io.write_full("acct-obj", b"x" * 4096)
+            assert cluster.io.read("acct-obj") == b"x" * 4096
+        finally:
+            cluster.shutdown()
+        dump = perf_collection.dump()
+        key = "loadgen_client.pool.loadpool"
+        assert key in dump
+        assert dump[key]["pool_op_w"] >= 1
+        assert dump[key]["pool_op_r"] >= 1
+        assert dump[key]["pool_bytes_w"] >= 4096
+        assert dump[key]["pool_bytes_r"] >= 4096
+        text = render_exposition(perf_collection)
+        assert (
+            'ceph_tpu_pool_op_w{pool="loadpool",'
+            'set="loadgen_client"}' in text
+        )
+
+
+class TestLiveStatsPlane:
+    """The deterministic-seed acceptance smoke: the stats plane sees
+    a primary kill as rising degraded counts + recovery rates, and
+    convergence back to clean exactly when recovery completes."""
+
+    def test_kill_degrades_revive_cleans(self):
+        from ceph_tpu.loadgen import LoadCluster
+
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=4, chunk_size=1024,
+        )
+        try:
+            rng_data = bytes(range(256)) * 16  # 4 KiB
+            for i in range(8):
+                cluster.io.write_full(f"sp-{i}", rng_data)
+            for d in cluster.daemons.values():
+                d.report_pg_stats(force=True)
+            pm = cluster.pgmap
+            st = status_dict(cluster.mon)
+            assert st["objects"] == 8
+            assert st["pgs"]["histogram"].get("active+clean", 0) >= 1
+            # client IO rates go nonzero once the cumulative counters
+            # move across two report samples — keep writing until the
+            # window sees the delta
+            deadline = time.monotonic() + 15.0
+            i = 0
+            while time.monotonic() < deadline:
+                cluster.io.write_full(f"sp-{i % 8}", rng_data)
+                i += 1
+                io = pm.rates()
+                if io["client_write_bps"] > 0:
+                    break
+                time.sleep(0.05)
+            assert io["client_write_bps"] > 0
+            victim = cluster.most_primary_osd()
+            cluster.kill(victim)
+            # the takeover primaries report degraded object copies
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if pm.degraded_objects() > 0:
+                    break
+                time.sleep(0.1)
+            assert pm.degraded_objects() > 0, (
+                "stats plane never saw the kill"
+            )
+            hist = pm.state_histogram()
+            assert any("degraded" in k for k in hist), hist
+            checks = Manager(cluster.mon).health()["checks"]
+            assert "PG_DEGRADED" in checks
+            assert "object copies" in checks["PG_DEGRADED"]["detail"]
+            # revive: counts return to zero exactly when the legacy
+            # poll reports recovered (within one report interval)
+            cluster.revive(victim)
+            min_epoch = cluster.mon.osdmap.epoch
+            assert cluster.wait_recovered(timeout=60.0)
+            assert cluster.wait_recovered_stats(
+                timeout=10.0, min_epoch=min_epoch
+            ), "stats plane never converged after recovery"
+            assert pm.degraded_objects() == 0
+            hist = pm.state_histogram()
+            assert set(hist) == {"active+clean"}, hist
+        finally:
+            cluster.shutdown()
+
+    def test_time_to_recovered_agreement(self):
+        """The stats-derived time_to_recovered_s agrees with the
+        legacy direct-state poll within about one report interval
+        (0.5 s default + tick scheduling slack)."""
+        from ceph_tpu.loadgen import (
+            FaultSchedule,
+            LoadCluster,
+            WorkloadSpec,
+            run_spec,
+        )
+
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=4, chunk_size=1024,
+        )
+        try:
+            report = run_spec(cluster, WorkloadSpec(
+                mix={"seq_write": 2, "read": 1, "rmw_overwrite": 1},
+                object_size=4096, max_objects=8, queue_depth=4,
+                total_ops=60, seed=0x57A7,
+            ), FaultSchedule.primary_kill(60, recovery_timeout=60.0))
+        finally:
+            cluster.shutdown()
+        assert report["verify_failures"] == 0
+        assert report["errors"] == 0
+        assert report["recovered"]
+        fault = report["fault"]
+        assert "time_to_recovered_s" in fault, fault
+        assert "time_to_recovered_legacy_s" in fault, fault
+        # stats convergence trails the direct poll by at most one
+        # report interval (+ a tick of scheduling slack)
+        lag = (
+            fault["time_to_recovered_s"]
+            - fault["time_to_recovered_legacy_s"]
+        )
+        assert -0.001 <= lag <= 1.0, fault
+        # the run report carries the stats-plane snapshot
+        assert report["pg_states"] == {"active+clean": 4}
+        assert report["degraded_objects"] == 0
+        assert "active+clean" in report["status_digest"]
+
+    def test_interval_zero_disables_reporting(self):
+        from ceph_tpu.loadgen import LoadCluster
+
+        with config.override(osd_stats_report_interval=0.0):
+            cluster = LoadCluster(
+                n_osds=4, k=2, m=1, pg_num=4, chunk_size=1024,
+            )
+            try:
+                cluster.io.write_full("quiet", b"q" * 2048)
+                time.sleep(0.8)  # several ticks
+                assert cluster.pgmap.version == 0
+            finally:
+                cluster.shutdown()
+
+    def test_forensics_bundle_captures_stats(self, tmp_path):
+        from ceph_tpu.loadgen import LoadCluster, write_bundle
+
+        cluster = LoadCluster(
+            n_osds=4, k=2, m=1, pg_num=4, chunk_size=1024,
+        )
+        try:
+            cluster.io.write_full("fb-obj", b"f" * 2048)
+            manifest = write_bundle(
+                str(tmp_path), report={"verify_failures": 0},
+                reason="stats-plane unit", cluster=cluster,
+            )
+        finally:
+            cluster.shutdown()
+        assert "status.json" in manifest["files"]
+        assert "pg_dump.json" in manifest["files"]
+        bundle = tmp_path / manifest["stamp"]
+        st = json.loads((bundle / "status.json").read_text())
+        assert st["objects"] >= 1
+        dump = json.loads((bundle / "pg_dump.json").read_text())
+        assert dump["pg_stats"]

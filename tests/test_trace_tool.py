@@ -235,33 +235,39 @@ class TestLiveCluster:
     def test_loadgen_trace_capture_contract(self):
         """The pinned --trace-capture contract: a deterministic-seed
         smoke run captures >= N assembled traces whose span trees are
-        well-formed and whose Chrome JSON round-trips json.loads."""
+        well-formed and whose Chrome JSON round-trips json.loads.
+        Runs with op coalescing ON (the default): the coalesced
+        primary path opens per-op continue_trace spans, so the
+        primary subtree assembles for batched ops too — the round-14
+        known gap, closed and pinned green here."""
         from ceph_tpu.loadgen import (
             LoadCluster,
             WorkloadSpec,
             run_spec,
         )
-        from ceph_tpu.utils import config
 
         N = 4
         tracer.clear()
-        # coalescing off: the coalesced primary path does not open
-        # per-op continue_trace spans (documented gap), and this
-        # contract pins the fully-threaded tree shape
-        with config.override(osd_op_coalescing=False):
-            cluster = LoadCluster(
-                n_osds=5, k=2, m=1, pg_num=4, chunk_size=1024
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=4, chunk_size=1024
+        )
+        try:
+            report = run_spec(cluster, WorkloadSpec(
+                mix={"seq_write": 3, "read": 1,
+                     "rmw_overwrite": 1},
+                object_size=4096, max_objects=8, queue_depth=8,
+                total_ops=80, seed=0x7CE, trace_capture=N,
+            ))
+            coalesced = sum(
+                d.coalesce_pc.get("op_coalesced")
+                for d in cluster.daemons.values()
             )
-            try:
-                report = run_spec(cluster, WorkloadSpec(
-                    mix={"seq_write": 2, "read": 1,
-                         "rmw_overwrite": 1},
-                    object_size=4096, max_objects=8, queue_depth=4,
-                    total_ops=40, seed=0x7CE, trace_capture=N,
-                ))
-            finally:
-                cluster.shutdown()
+        finally:
+            cluster.shutdown()
         assert report["verify_failures"] == 0
+        # the coalesced path must actually have served batched ops —
+        # otherwise this run pinned nothing about it
+        assert coalesced > 0, "no ops coalesced: contract not exercised"
         cap = report["traces"]
         assert cap["captured"] >= N
         assert cap["total_traces"] >= N
@@ -272,6 +278,26 @@ class TestLiveCluster:
             f"only {len(well_formed)} of {len(cap['trees'])} "
             "captured trees are well-formed"
         )
+        # the primary subtree assembles under coalescing: every
+        # captured WRITE trace roots at client_op and carries an
+        # osd_op child with sub_write grandchildren
+        def _names(node, out):
+            out.append(node["name"])
+            for c in node["children"]:
+                _names(c, out)
+            return out
+
+        writes = [
+            t for t in cap["trees"]
+            if t["roots"][0]["name"] == "client_op"
+            and t["roots"][0]["tags"].get("op") in (
+                "write", "writefull"
+            )
+        ]
+        for t in writes:
+            names = _names(t["roots"][0], [])
+            assert "osd_op" in names, names
+            assert "sub_write" in names, names
         # Chrome JSON round-trips and has events
         data = json.loads(cap["chrome_json"])
         assert data["traceEvents"]

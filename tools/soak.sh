@@ -59,6 +59,7 @@ LOAD_PID=""
 if [ -z "${SOAK_NO_LOAD:-}" ]; then
     (
         seed=1
+        lap_log=$(mktemp /tmp/soak-lap.XXXXXX)
         while true; do
             # a fresh seed per lap: every lap is deterministic alone
             # (same seed => same firings) while the soak as a whole
@@ -66,7 +67,11 @@ if [ -z "${SOAK_NO_LOAD:-}" ]; then
             # laps leave a forensics bundle under $FORENSICS_DIR.
             python -m ceph_tpu.bench_cli loadgen --smoke \
                 --seed "$seed" $LOAD_FLAGS $FORENSICS_FLAGS \
-                >/dev/null 2>&1 || true
+                >/dev/null 2>"$lap_log" || true
+            # one-line `cli status` digest per lap (the stats plane's
+            # PG histogram + IO rates at end of run)
+            grep -h '^status digest:' "$lap_log" \
+                | sed "s/^/soak lap $seed /" || true
             if [ -n "${SOAK_FORCE_FORENSICS:-}" ]; then
                 # the smoke hook dumps once, not every lap
                 FORENSICS_FLAGS="--forensics-dir $FORENSICS_DIR --slow-convergence-s $SLOW_S"
