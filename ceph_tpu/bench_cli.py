@@ -168,6 +168,12 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     lg.add_argument("--force-forensics", action="store_true",
                     help="treat the run as non-green regardless of "
                          "outcome (the forensics smoke-test hook)")
+    lg.add_argument("--lockdep", action="store_true",
+                    help="arm the runtime lock-order / blocking-"
+                         "under-lock detector for the run "
+                         "(utils/lockdep.py): findings land in the "
+                         "report + forensics bundle (lockdep.json) "
+                         "and fail the run like a verify failure")
     lg.add_argument("--smoke", action="store_true",
                     help="tiny deterministic end-to-end run (CI "
                          "surface): smoke preset, 4 OSDs, one "
@@ -400,6 +406,15 @@ def _run_loadgen(args) -> tuple[float, float]:
 
     net_fault = getattr(args, "net_fault", "none")
     overrides = dict(osd_op_coalescing=(args.coalesce == "on"))
+    if args.lockdep:
+        # arm the runtime lock-order / blocking-under-lock detector
+        # for this cluster (locks read the flag at construction);
+        # findings land in the report + forensics bundle and fail
+        # the run like a verify failure
+        from ceph_tpu.utils import lockdep as _lockdep
+
+        _lockdep.reset()
+        overrides["lockdep"] = True
     if net_fault != "none":
         # lost frames must resolve inside the client's resend
         # ladder, not a 10 s peer-RPC stall per drop (daemons read
@@ -473,6 +488,10 @@ def _run_loadgen(args) -> tuple[float, float]:
             d.coalesce_pc.get("subwrite_batches")
             for d in cluster.daemons.values()
         )
+        if args.lockdep:
+            from ceph_tpu.utils import lockdep as _lockdep
+
+            report["lockdep"] = _lockdep.findings()
         # forensics BEFORE teardown and before any raise: wedged ops
         # are still live, the cluster log still holds this run's tail
         from ceph_tpu.loadgen.forensics import run_is_green
@@ -521,6 +540,11 @@ def _run_loadgen(args) -> tuple[float, float]:
             raise RuntimeError(
                 f"{report['verify_failures']} ops failed "
                 "content/checksum verification"
+            )
+        if args.lockdep and any(report.get("lockdep", {}).values()):
+            raise RuntimeError(
+                f"lockdep findings: {report['lockdep']} (dump: "
+                "admin-socket `lockdep`; bundle: lockdep.json)"
             )
     finally:
         cluster.shutdown()

@@ -34,6 +34,7 @@ import math
 import threading
 
 from .osdmap import SHARD_NONE, OSDMap
+from ceph_tpu.utils.lockdep import DebugLock
 
 #: the reference's mon_target_pg_per_osd default is 100 PG *shards*
 TARGET_PG_SHARDS_PER_OSD = 100
@@ -65,7 +66,7 @@ class Manager:
         #: unbounded raise would grow geometrically under tick() and
         #: churn a reweight epoch + backfill every pass
         self.max_weight = max_weight
-        self._lock = threading.Lock()
+        self._lock = DebugLock("mgr.health")
         self.last_health: dict = {"status": "HEALTH_OK", "checks": {}}
 
     # -- distribution math ---------------------------------------------

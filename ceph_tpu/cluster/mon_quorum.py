@@ -36,6 +36,7 @@ from collections.abc import Callable
 from .monitor import Monitor
 from .osdmap import Incremental, OSDMap
 from .paxos import MonCluster, QuorumLost
+from ceph_tpu.utils.lockdep import DebugRLock
 
 
 class MonQuorumService:
@@ -52,7 +53,7 @@ class MonQuorumService:
         self.paxos = MonCluster(n)
         self.n = n
         self.dead: set[int] = set()
-        self._lock = threading.RLock()
+        self._lock = DebugRLock("mon.quorum")
         self._subs: list[Callable[[OSDMap], None]] = []
         self._notified_epoch = initial.epoch if initial is not None else 0
         #: durability seam: (rank, incr) for every incremental a rank

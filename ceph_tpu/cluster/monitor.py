@@ -36,6 +36,7 @@ from ceph_tpu.codecs import registry
 from ceph_tpu.utils import config
 
 from .osdmap import Incremental, OSDInfo, OSDMap, PoolSpec
+from ceph_tpu.utils.lockdep import DebugRLock
 
 
 class CommandError(Exception):
@@ -63,7 +64,7 @@ class Monitor:
         self.pgmap = PGMap()
         self._commit_fn = commit_fn
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = DebugRLock("mon.cmd", rank=10)
         self._subscribers: list[Callable[[OSDMap], None]] = []
         #: incremental history for catch-up, keyed by produced epoch
         self._incrementals: dict[int, Incremental] = {}

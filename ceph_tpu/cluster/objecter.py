@@ -48,6 +48,7 @@ from ceph_tpu.utils import tracer
 from ceph_tpu.utils.optracker import NULL_OP, op_tracker
 
 from .osdmap import SHARD_NONE
+from ceph_tpu.utils.lockdep import DebugLock
 
 
 class NoPrimary(Exception):
@@ -192,7 +193,7 @@ class Objecter:
 
         self.client_id = uuid.uuid4().hex[:12]
         self._reqs = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = DebugLock("client.objecter")
         #: wire tid -> _AsyncOp awaiting that attempt's reply
         self._waiting: dict[int, _AsyncOp] = {}
         #: osd id -> in-flight window + parked queue

@@ -27,11 +27,11 @@ client re-targets (Objecter resend contract, osdc/Objecter.cc:2127).
 Peering — the authoritative-log election, the self-rewind, interval
 fencing and returning-member admission — is driven by the per-PG
 state machine in ``cluster/peering.py`` (the PeeringState.cc analog;
-``osd_peering_fsm=false`` re-selects the legacy thread-and-flags path
-kept below for bisection). This module keeps the peering PRIMITIVES
-the FSM composes: ``_own_pg_info``, ``_bump_fence``,
-``_pgmeta_write_les``, ``_sub_write_interval_ok``, the PGInfo/
-PGActivate services, and ``_catch_up_shard``.
+the pre-FSM thread-and-flags path was folded out in round 16 after
+four rounds of green soaks — ROADMAP closeout 1b). This module keeps
+the peering PRIMITIVES the FSM composes: ``_own_pg_info``,
+``_bump_fence``, ``_pgmeta_write_les``, ``_sub_write_interval_ok``,
+the PGInfo/PGActivate services, and ``_catch_up_shard``.
 
 Client ops are serialized by a daemon op lock (the reference serializes
 per-PG via op queues; the mClock scheduler seam slots in here).
@@ -88,6 +88,7 @@ from ceph_tpu.pipeline.rmw import (
 from ceph_tpu.pipeline.stripe import StripeInfo
 from ceph_tpu.store import MemStore, Transaction
 from ceph_tpu.utils import tracer
+from ceph_tpu.utils.lockdep import DebugLock
 from ceph_tpu.utils.mclock import MClockScheduler
 
 from .osdmap import OSDMap, SHARD_NONE
@@ -526,18 +527,12 @@ class _PG:
         #: for this interval. Non-primaries are trivially peered —
         #: they only serve sub-ops, which the (peered) primary drives.
         self.peered = threading.Event()
-        self._peering = False
-        self._repeer = False
         if first_live(acting) != daemon.osd_id:
             self.peered.set()
-        # explicit peering FSM (cluster/peering.py) unless the
-        # bisection escape hatch re-selects the legacy thread path
-        from ceph_tpu.utils import config as _cfg
-
-        self.fsm = (
-            PgPeeringFsm(daemon, self)
-            if _cfg.get("osd_peering_fsm") else None
-        )
+        # explicit peering FSM (cluster/peering.py) — the only driver
+        # of the peered gate since the legacy thread-and-flags path
+        # folded out (round 16)
+        self.fsm = PgPeeringFsm(daemon, self)
         self.codec = registry.factory(spec.plugin, profile)
         chunk = daemon.chunk_size
         self.sinfo = StripeInfo(spec.k, spec.m, spec.k * chunk)
@@ -649,10 +644,12 @@ class OSDDaemon:
         self.scheduler = MClockScheduler(scheduler_profiles)
         self._sched_cv = threading.Condition()
         self._worker: threading.Thread | None = None
-        self._op_lock = threading.Lock()   # serializes client ops
-        self._pg_lock = threading.Lock()   # guards _pgs + peer addrs
-        self._peer_lock = threading.Lock()  # guards _PG._peering flags
-        self._pgmeta_lock = threading.Lock()  # serializes les updates
+        # op-serializing + structural locks, lockdep-tracked when the
+        # `lockdep` config arms the detector (utils/lockdep.py; the
+        # rank map documents the intended order: op -> pg -> stores)
+        self._op_lock = DebugLock("osd.op", rank=20, op_serializing=True)
+        self._pg_lock = DebugLock("osd.pg", rank=30)
+        self._pgmeta_lock = DebugLock("osd.pgmeta")  # serializes les updates
         #: mon config db entries this daemon has applied to the
         #: process config's "mon" layer (name -> value)
         self._mon_cfg_applied: dict[str, str] = {}
@@ -692,7 +689,7 @@ class OSDDaemon:
         #: daemon-wide budget bounding concurrent poller threads
         self._req_poll_results: dict[str, tuple] = {}
         self._req_polls_inflight: set[str] = set()
-        self._req_poll_lock = threading.Lock()
+        self._req_poll_lock = DebugLock("osd.req_poll")
         self._req_poll_sem = threading.Semaphore(self.REQ_POLL_BUDGET)
         #: queued reqid-cache invalidations from _kick_peering /
         #: pool deletion, applied under _op_lock by the next client
@@ -706,7 +703,7 @@ class OSDDaemon:
         #: by _req_flush_lock, a leaf lock never held across another
         #: acquire.
         self._req_flush: set = set()
-        self._req_flush_lock = threading.Lock()
+        self._req_flush_lock = DebugLock("osd.req_flush", rank=90)
         self._completed_cap = 1024
         self._stopped = False
         # -- background scrub scheduling (osd/scrubber/osd_scrub.cc):
@@ -721,7 +718,7 @@ class OSDDaemon:
         #: so without this a slow scrub would be re-scheduled — the
         #: per-PG reservation role)
         self._scrubs_inflight: set[tuple[str, int]] = set()
-        self._scrub_lock = threading.Lock()
+        self._scrub_lock = DebugLock("osd.scrub")
         #: (pool, pgid) -> (monotonic stamp, kind, n_errors, repaired)
         self.scrub_history: dict[tuple[str, int], tuple] = {}
         # -- PG-stats reporting (the MPGStats sender): the tick ships
@@ -734,7 +731,7 @@ class OSDDaemon:
         #: when the epoch moves, never per report
         self._led_cache: tuple[int, set] = (-1, set())
         # -- watch/notify soft state (osd/Watch.cc role)
-        self._watch_lock = threading.Lock()
+        self._watch_lock = DebugLock("osd.watch")
         #: (pool, loc) -> {cookie: Connection}
         self._watchers: dict[tuple[str, str], dict] = {}
         self._pending_notifies: dict[int, tuple] = {}
@@ -1042,10 +1039,8 @@ class OSDDaemon:
                 # open their gate — the primary's peering judges them.
                 if first_live(new_acting) == self.osd_id:
                     self._kick_peering(pg)
-                elif pg.fsm is not None:
-                    pg.fsm.post_interval()  # -> replica, gate open
                 else:
-                    pg.peered.set()
+                    pg.fsm.post_interval()  # -> replica, gate open
                 if downed:
                     to_release.append((pg, downed))
                 if healed:
@@ -1062,34 +1057,28 @@ class OSDDaemon:
             for i in downed:
                 pg.rmw.on_shard_down(i)
         for pg, healed in to_recover:
-            if (
-                pg.fsm is not None
-                and first_live(pg.acting) != self.osd_id
-            ):
-                # FSM path: only the SERVING PRIMARY drives catch-up
-                # (the reference's recovery model). A demoted
-                # instance replaying ITS pglog onto a member of a PG
-                # someone else now leads raced the new primary's live
-                # writes — rebuild-at-T, push-at-T+δ lost updates
-                # clobbered freshly committed extents on one shard
-                # (the torn-RMW leg of ROADMAP #1, found by the
+            if first_live(pg.acting) != self.osd_id:
+                # only the SERVING PRIMARY drives catch-up (the
+                # reference's recovery model). A demoted instance
+                # replaying ITS pglog onto a member of a PG someone
+                # else now leads raced the new primary's live writes
+                # — rebuild-at-T, push-at-T+δ lost updates clobbered
+                # freshly committed extents on one shard (the
+                # torn-RMW leg of ROADMAP #1, found by the
                 # primary-victim smoke). The new primary's election
                 # judges every member by its gathered infos and
                 # drains EVERY stale recovering mark itself (see
                 # _peer_pass), so marks left here are not leaked.
                 continue
             for shard in healed:
-                if (
-                    pg.fsm is not None
-                    and pg.acting[shard] == self.osd_id
-                ):
+                if pg.acting[shard] == self.osd_id:
                     # my OWN position healed: the FSM's election pass
                     # (already kicked above) judges and repairs my
                     # store and re-admits the position at Active —
-                    # the legacy path ran the replica catch-up
-                    # against itself here (an RPC to nobody), failed,
-                    # and holed its own primary position (THE
-                    # round-8 peering flake / ROADMAP #1 ENOENT)
+                    # a replica catch-up against oneself would be an
+                    # RPC to nobody that fails and holes the primary
+                    # position (THE round-8 peering flake / ROADMAP
+                    # #1 ENOENT)
                     continue
                 self._spawn_catch_up(pg, shard)
         for pool, pgid, pg in maybe_backfill:
@@ -1206,7 +1195,7 @@ class OSDDaemon:
             # only established once the primary has peered
             if not pg.peered.wait(timeout=60):
                 raise RuntimeError("peering never completed")
-            if pg.fsm is not None and pg.acting[shard] == self.osd_id:
+            if pg.acting[shard] == self.osd_id:
                 # my own position is the election's to admit, never a
                 # peer transfer (see _admit_self_positions); a stray
                 # spawn must not RPC to itself and hole the position
@@ -1215,18 +1204,12 @@ class OSDDaemon:
             crash_points.fire(
                 "catchup.pre_listing", daemon=self, pg=pg, shard=shard
             )
-            # FSM path: every rebuild-and-push below holds _op_lock,
+            # every rebuild-and-push below holds _op_lock,
             # serializing with the live write path — a push computed
             # from survivors read at T must not land at T+δ over an
             # extent a client write committed in between (the
-            # lost-update shard tear the primary-victim soak caught).
-            # Legacy keeps the unserialized pushes (escape hatch).
-            import contextlib
-
-            push_lock = (
-                self._op_lock if pg.fsm is not None
-                else contextlib.nullcontext()
-            )
+            # lost-update shard tear the primary-victim soak caught)
+            push_lock = self._op_lock
             # Pristine member stamps, captured before any replay or
             # refresh can overwrite them (see _member_listing).
             member_listing = self._member_listing(pg, shard)
@@ -1315,39 +1298,21 @@ class OSDDaemon:
                 with push_lock:
                     self._push_delete(target_osd, loc, shard)
                 self.rmw_crash_pc.inc("divergent_removes")
-            # Admission happens under the op lock with a final clean
-            # check: client writes (which also take _op_lock) cannot
-            # append dirty entries between the check and the admit, so
-            # a still-behind shard can never enter the read set and
-            # serve stale bytes into EC decode. If the retry budget
-            # ran out, one more replay runs here race-free — WITHOUT
-            # QoS admission: admit() grants fire on the worker thread,
-            # which may itself be blocked on _op_lock (the backfill
-            # final pass skips admission under the lock for the same
-            # reason). A shard dirty even then reverts to a hole
-            # (except path below). On the FSM path the admission is an
-            # EVENT on the PG's peering queue — it cannot interleave
-            # an election, so a mid-judgment member can never vote.
+            # Admission is an EVENT on the PG's peering queue — it
+            # cannot interleave an election, so a mid-judgment member
+            # can never vote. The final clean check runs under the op
+            # lock on the drainer: client writes (which also take
+            # _op_lock) cannot append dirty entries between the check
+            # and the admit, so a still-behind shard can never enter
+            # the read set and serve stale bytes into EC decode.
             crash_points.fire(
                 "catchup.pre_admit", daemon=self, pg=pg, shard=shard
             )
-            if pg.fsm is not None:
-                if not pg.fsm.admit_caught_up(shard):
-                    raise RuntimeError(
-                        f"shard {shard} admission rejected "
-                        "(interval moved or still dirty)"
-                    )
-            else:
-                with self._op_lock:
-                    if _dirty():
-                        pg.recovery.recover_from_log(pg.pglog, shard)
-                    if _dirty():
-                        raise RuntimeError(
-                            f"shard {shard} still dirty after replay "
-                            "budget"
-                        )
-                    pg.backend.recovering.discard(shard)
-                    pg.rmw.on_shard_recovered(shard)
+            if not pg.fsm.admit_caught_up(shard):
+                raise RuntimeError(
+                    f"shard {shard} admission rejected "
+                    "(interval moved or still dirty)"
+                )
             self.log.info(
                 "pg", f"{pg.pool}/{pg.pgid}:", "shard", shard,
                 "caught up, admitted"
@@ -1755,215 +1720,10 @@ class OSDDaemon:
                 self._req_flush.add(
                     ("pg", spec.pool_id, spec.pg_num, pg.pgid)
                 )
-        if pg.fsm is not None:
-            # FSM path: the interval event serializes with every
-            # other peering event of this PG; the gate flips
-            # synchronously inside post_interval (ops eagain the
-            # moment the interval moves, like the legacy kick)
-            pg.fsm.post_interval()
-            return
-        with self._peer_lock:
-            pg.peered.clear()
-            if pg._peering:
-                pg._repeer = True
-                return
-            pg._peering = True
-        threading.Thread(
-            target=self._peer_pg, args=(pg,), daemon=True
-        ).start()
-
-    def _peer_pg(self, pg: _PG) -> None:
-        """Election loop: re-runs while interval changes arrive
-        mid-election; the gate opens only when a full election has
-        seen the latest interval."""
-        while True:
-            done = self._peer_pg_once(pg)
-            with self._peer_lock:
-                if pg._repeer:
-                    pg._repeer = False
-                    continue  # a newer interval arrived mid-election
-                pg._peering = False
-                if done:
-                    # serve the NEW interval from the store, not from
-                    # the last primacy's in-memory projections (see
-                    # RMWPipeline.on_interval_change)
-                    pg.rmw.on_interval_change()
-                    pg.peered.set()
-                return
-
-    def _peer_pg_once(self, pg: _PG) -> bool:
-        """One election + self-rewind + activation pass; True on
-        success. On failure the gate stays closed (ops eagain; the
-        tick and the next map change retry) — serving unpeered is
-        the one thing this path exists to prevent."""
-        try:
-            spec = self.osdmap.pools[pg.pool]
-            # the interval this election is FOR, captured once: a
-            # newer map arriving mid-election invalidates every
-            # judgment made here. Without this guard (round-5 chaos
-            # seed 7702), an election kicked for epoch E ran with
-            # epoch E+1's membership half-applied, a reviving
-            # divergent member's racing activation tied the les
-            # ledger, its inflated tids won the tie, and a GOOD
-            # member rewound itself from the tampered store.
-            epoch0 = self.osdmap.epoch
-            acting0 = list(pg.acting)
-            if first_live(acting0) != self.osd_id:
-                # a kick from an older interval can fire after a newer
-                # map demoted this daemon — only the CURRENT primary
-                # may elect/rewind/activate (choose_acting runs on the
-                # primary, PeeringState.cc:2413)
-                return False
-            try:
-                my_pos = acting0.index(self.osd_id)
-            except ValueError:
-                return False  # no longer a member; a map re-kicks
-            self.peering_pc.inc("elections_run")
-            infos: dict[int, tuple[int, tuple[int, int]]] = {}
-            for idx, osd in enumerate(acting0):
-                if osd == SHARD_NONE:
-                    continue
-                if idx in pg.backend.recovering and osd != self.osd_id:
-                    # a member mid-catch-up is mid-JUDGMENT: its OI
-                    # stamps may still carry divergent tids the
-                    # rollback has not rewritten. Counting it at a
-                    # les tie elected a tampered store as authority
-                    # (round-5 chaos seed 7702); it votes again once
-                    # admitted (clean by construction).
-                    continue
-                if osd == self.osd_id:
-                    # fence myself first: my own replica role must
-                    # reject older-interval sub-writes from here on
-                    self._bump_fence(spec.pool_id, pg.pgid, epoch0)
-                    infos[osd] = self._own_pg_info(
-                        spec.pool_id, spec.pg_num, pg.pgid
-                    )
-                    continue
-                try:
-                    # the query carries epoch0: answering FENCES the
-                    # member against older-interval sub-writes, so
-                    # nothing can commit behind this election's back
-                    infos[osd] = self.peers.get_pg_info(
-                        osd, spec.pool_id, spec.pg_num, pg.pgid,
-                        epoch=epoch0,
-                    )
-                except Exception:
-                    continue  # down members don't vote
-            # max by (les, last_update); ties prefer self (authority
-            # continuity), then lowest osd id — deterministic
-            best = max(
-                infos,
-                key=lambda o: (infos[o], o == self.osd_id, -o),
-            )
-            if best != self.osd_id and infos[best] > infos[self.osd_id]:
-                if (
-                    self.osdmap.epoch != epoch0
-                    or list(pg.acting) != acting0
-                ):
-                    return False  # stale interval: don't touch data
-                self.log.info(
-                    "pg", f"{pg.pool}/{pg.pgid}:", "peering: osd.",
-                    best, "has the authoritative log", infos[best],
-                    "over mine", infos[self.osd_id], "- rewinding self"
-                )
-                self._rewind_self(pg, spec, my_pos, best)
-            # current-interval check BEFORE activation: activating a
-            # superseded interval would stamp les for membership this
-            # election never judged
-            if self.osdmap.epoch != epoch0 or list(pg.acting) != acting0:
-                return False  # the newer map's kick re-runs
-            # activate: les := this map epoch, durable on me and every
-            # reachable member (a partitioned member keeps its old les
-            # — that is what future elections rank it down by)
-            self._pgmeta_write_les(
-                spec.pool_id, pg.pgid, epoch0, acting=acting0
-            )
-            for osd in acting0:
-                if osd in (SHARD_NONE, self.osd_id):
-                    continue
-                try:
-                    self.peers.activate_pg(
-                        osd, spec.pool_id, pg.pgid, epoch0
-                    )
-                except Exception:
-                    pass
-            self.log.info(
-                "pg", f"{pg.pool}/{pg.pgid}:", "peered at epoch",
-                epoch0, "(authority: osd.", best, ")"
-            )
-            return True
-        except Exception as e:
-            self.log.error(
-                "pg", f"{pg.pool}/{pg.pgid}:", "peering failed",
-                f"({type(e).__name__}: {e}); gate stays closed"
-            )
-            return False
-
-    def _rewind_self(
-        self, pg: _PG, spec, my_pos: int, best: int
-    ) -> None:
-        """Rewind my own shard against the elected authority: adopt
-        its per-object eversions as the judgment source, roll back my
-        objects whose stamps are not in its history, remove my
-        divergent creates (PGLog::rewind_divergent_log applied to the
-        ex-primary itself)."""
-        self.peering_pc.inc("rewinds")
-        listing = self.peers.list_pg(
-            best, spec.pool_id, spec.pg_num, pg.pgid
-        )
-        auth: dict[str, tuple[int, tuple[int, int]]] = {}
-        for loc, _si, size, *ev in listing:
-            aev = tuple(ev) if len(ev) == 2 else (0, 0)
-            if loc not in auth or aev > auth[loc][1]:
-                auth[loc] = (size, aev)
-        # my own pristine stamps, BEFORE any recovery can overwrite
-        mine = []
-        for loc, si in self._scan_pg_keys(
-            spec.pool_id, spec.pg_num, pg.pgid
-        ):
-            if si != my_pos:
-                continue
-            try:
-                size, ev = parse_oi(
-                    self.store.getattr(shard_key(loc, si), OI_KEY)
-                )
-            except (FileNotFoundError, KeyError, ValueError):
-                continue
-            mine.append((loc, tuple(ev)))
-        # adopt the authority's knowledge: later judgments (returning
-        # replicas, reads priming sizes) must answer from the elected
-        # history, not from my divergent attrs
-        for loc, (size, aev) in auth.items():
-            if aev != (0, 0):
-                pg.rmw.prime_object(
-                    loc, max(size, 0), eversion=aev
-                )
-        for loc, mev in mine:
-            if mev == (0, 0):
-                continue  # pre-eversion stamp: nothing to judge
-            entry = auth.get(loc)
-            if entry is None:
-                # divergent create: only I ever heard of it
-                self.log.info(
-                    "pg", f"{pg.pool}/{pg.pgid}:",
-                    "peering: divergent create", loc, "- removing"
-                )
-                key = shard_key(loc, my_pos)
-                self.store.queue_transactions(
-                    Transaction().touch(key).remove(key)
-                )
-                pg.rmw.forget_object(loc)
-            elif entry[1] != mev:
-                self.log.info(
-                    "pg", f"{pg.pool}/{pg.pgid}:",
-                    "peering: divergent object", loc,
-                    "- rolling back from survivors"
-                )
-                # NO QoS admission here: admission grants fire on the
-                # worker thread, which may itself be parked in the
-                # peering gate — peering is control plane and must
-                # never wait on the data plane
-                pg.recovery.recover_object(loc, {my_pos})
+        # the interval event serializes with every other peering
+        # event of this PG; the gate flips synchronously inside
+        # post_interval (ops eagain the moment the interval moves)
+        pg.fsm.post_interval()
 
     def _object_size(self, pg: _PG, oid: str) -> int:
         size = pg.rmw.object_size(oid)
@@ -3757,10 +3517,7 @@ class OSDDaemon:
                 pg for pg in self._pgs.values()
                 if not pg.peered.is_set()
                 and first_live(pg.acting) == self.osd_id
-                and (
-                    not pg.fsm._draining if pg.fsm is not None
-                    else not pg._peering
-                )
+                and not pg.fsm._draining
             ]
         for pg in stuck:
             self._kick_peering(pg)
@@ -3811,7 +3568,7 @@ class OSDDaemon:
                 "pg", f"{pg.pool}/{pg.pgid}:", "re-healing shard",
                 shard, "(previous catch-up failed)"
             )
-            if pg.fsm is not None and pg.acting[shard] == self.osd_id:
+            if pg.acting[shard] == self.osd_id:
                 # my own position: the election re-admits it (see
                 # _admit_self_positions) — never a transfer to self
                 pg.fsm.post_interval()

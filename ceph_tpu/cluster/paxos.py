@@ -35,6 +35,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
+from ceph_tpu.utils.lockdep import DebugRLock, checked_sleep
 
 #: propose() gives up (QuorumLost) after this many prepare/accept
 #: rounds: an unbounded retry loop livelocks when two proposers keep
@@ -122,7 +123,7 @@ class PaxosNode:
         self.n_nodes = n_nodes
         self.slots: dict[int, _SlotState] = {}
         self._round = 0
-        self._lock = threading.RLock()
+        self._lock = DebugRLock("mon.paxos")
         transport.register(self)
 
     # -- local helpers --------------------------------------------------
@@ -204,7 +205,10 @@ class PaxosNode:
                 # jittered backoff: two live proposers refusing each
                 # other's pn forever is the classic Paxos livelock;
                 # desynchronizing the rounds lets one win
-                time.sleep(random.uniform(0, 0.002 * round_no))
+                checked_sleep(
+                    random.uniform(0, 0.002 * round_no),
+                    label="paxos.backoff",
+                )
             pn = self._next_pn()
             # phase 1: prepare / collect
             promises = 0

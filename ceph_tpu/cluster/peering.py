@@ -102,8 +102,11 @@ to pause (and later release), fail the transition, kill the daemon, or
 run a callback, turning 1-in-20 loadgen interleavings into pinned,
 repeatable regression tests.
 
-The pre-refactor thread-and-flags peering survives verbatim behind
-``osd_peering_fsm=false`` (the bisection escape hatch).
+The pre-refactor thread-and-flags peering (the ``osd_peering_fsm=
+false`` bisection escape hatch) was folded out in round 16 after four
+rounds of green soaks — the FSM is the only peering driver, which is
+also what keeps the lockdep certification surface single
+(ROADMAP closeout 1b).
 """
 
 from __future__ import annotations
@@ -111,6 +114,8 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+
+from ceph_tpu.utils.lockdep import DebugLock
 
 from .osdmap import SHARD_NONE
 
@@ -201,7 +206,7 @@ class PgPeeringFsm:
             RESET if first_live(pg.acting) == daemon.osd_id
             else REPLICA
         )
-        self._mu = threading.Lock()
+        self._mu = DebugLock("osd.peering_events")
         self._events: deque = deque()
         self._draining = False
         self._entered_at = time.monotonic()

@@ -44,6 +44,7 @@ import time
 import weakref
 from collections import deque
 from dataclasses import dataclass, field
+from ceph_tpu.utils.lockdep import DebugLock
 
 #: the state vocabulary reports may carry (pg_state_t bit names)
 PG_STATES = (
@@ -63,6 +64,30 @@ _current_pgmap: "weakref.ref[PGMap] | None" = None
 
 def current_pgmap() -> "PGMap | None":
     return _current_pgmap() if _current_pgmap is not None else None
+
+
+def _register_admin() -> None:
+    """Hang the ``pgmap`` command on the process admin socket.  The
+    registration lives HERE (not in utils/admin_socket.py's builtins)
+    so the utils tier never imports up into the cluster tier — ECLint
+    EC101 pins that layering."""
+    from ceph_tpu.utils.admin_socket import admin_socket
+
+    def _dump():
+        pgmap = current_pgmap()
+        return pgmap.dump() if pgmap is not None else {}
+
+    try:
+        admin_socket.register(
+            "pgmap", _dump,
+            "the PGMap aggregate (per-PG stats, pool/cluster totals, "
+            "state histogram, windowed IO/recovery rates)",
+        )
+    except ValueError:
+        pass  # already registered (module reloaded)
+
+
+_register_admin()
 
 
 @dataclass
@@ -159,7 +184,7 @@ class PGMap:
 
     def __init__(self, clock=time.monotonic) -> None:
         global _current_pgmap
-        self._lock = threading.Lock()
+        self._lock = DebugLock("mon.pgmap")
         self._clock = clock
         #: (pool_id, pgid) -> latest accepted PGStats
         self.pg: dict[tuple[int, int], PGStats] = {}

@@ -47,6 +47,8 @@ from ceph_tpu.cluster.osdmap import SHARD_NONE
 
 from .faults import FaultSchedule
 from .recorder import DeviceClock, RunRecorder
+from ceph_tpu.utils.lockdep import DebugLock
+
 from .spec import (
     Popularity,
     WorkloadSpec,
@@ -84,7 +86,7 @@ class LoadGenerator:
         self._ops_done = 0
         self._seq_next = 0
         self._objects: dict[int, _ObjState] = {}
-        self._obj_lock = threading.Lock()
+        self._obj_lock = DebugLock("loadgen.objects")
         self._pick = Popularity(spec)
         self._stop = threading.Event()
         self._errors: list[str] = []

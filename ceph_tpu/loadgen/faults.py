@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from ceph_tpu.utils.lockdep import DebugLock
 
 
 #: named victim pickers a kill event may carry instead of an osd id;
@@ -74,7 +75,7 @@ class FaultSchedule:
 
     def __post_init__(self) -> None:
         self.events = sorted(self.events, key=lambda e: e.at_op)
-        self._lock = threading.Lock()
+        self._lock = DebugLock("loadgen.faults")
         self._next = 0
         self.kill_at: float | None = None      # monotonic stamps
         self.revive_at: float | None = None

@@ -18,6 +18,10 @@ wedged ops are still live:
                            complaints, peering stalls, net-fault
                            arms, crash-point fires)
 - ``perf_dump.json``       the full perf-counter collection
+- ``lockdep.json``         the lock-dependency graph + findings
+                           (cycles / rank violations / blocking-
+                           under-lock, with backtraces) when the
+                           run armed the lockdep detector
 - ``report.json``          the run report that triggered the dump
 - ``status.json``          the `ceph -s` snapshot from the stats
                            plane (when a cluster is passed in)
@@ -55,6 +59,15 @@ def run_is_green(
         return False, f"{report['errors']} op errors"
     if "recovered" in report and not report["recovered"]:
         return False, "cluster not recovered at exit"
+    ld = report.get("lockdep")
+    if ld and any(ld.values()):
+        # lockdep-armed run (soak.sh --lockdep): a cycle / rank
+        # violation / unwaived blocking-under-lock finding is as red
+        # as a verify failure — it is tomorrow's deadlock
+        return False, (
+            "lockdep findings: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(ld.items()) if v)
+        )
     ttr = (report.get("fault") or {}).get("time_to_recovered_s")
     if (
         slow_convergence_s > 0
@@ -113,6 +126,11 @@ def write_bundle(
     dump("traces_chrome.json", traces["chrome_json"])
     dump("cluster_log.jsonl", cluster_log.last(2000), jsonl=True)
     dump("perf_dump.json", perf_collection.dump())
+    from ceph_tpu.utils import lockdep
+
+    # the lockdep graph + findings (cycles/rank/blocking carry full
+    # backtraces) — trivially small when the detector is disarmed
+    dump("lockdep.json", lockdep.dump())
     if report is not None:
         dump("report.json", report)
     mon = getattr(cluster, "mon", None)

@@ -28,6 +28,7 @@ import threading
 import time
 
 from .histogram import Log2Histogram
+from ceph_tpu.utils.lockdep import DebugLock
 
 
 class ClassStats:
@@ -52,7 +53,7 @@ class RunRecorder:
     equal ops issued at the end (the exactly-once check)."""
 
     def __init__(self, warmup_ops: int = 0) -> None:
-        self._lock = threading.Lock()
+        self._lock = DebugLock("loadgen.recorder")
         self._classes: dict[str, ClassStats] = {}
         self._warmup_ops = warmup_ops
         self._done = 0

@@ -40,6 +40,8 @@ from collections.abc import Callable
 from . import secure as secure_mod
 from .messages import decode_message, message_type
 from .wire import BadFrame, decode_frame, encode_frame
+from ceph_tpu.utils import lockdep
+from ceph_tpu.utils.lockdep import DebugLock
 
 
 #: listening addr -> messenger name, registered at bind() — how a
@@ -47,7 +49,7 @@ from .wire import BadFrame, decode_frame, encode_frame
 #: its link rules on (src, dst) daemon names (in-process clusters
 #: only; a cross-host deployment would carry names in a hello frame)
 _addr_names: dict[tuple[str, int], str] = {}
-_addr_lock = threading.Lock()
+_addr_lock = DebugLock("msgr.addr_registry")
 
 
 class LinkRule:
@@ -113,7 +115,7 @@ class _Lane:
         self.rule = rule
         self.rng = random.Random(seed)
         self.held: "Callable[[], None] | None" = None
-        self.lock = threading.Lock()
+        self.lock = DebugLock("msgr.net_lane")
 
 
 #: counters the plane keeps (process totals; per-daemon slices ride
@@ -138,7 +140,7 @@ class NetFaultPlane:
     REORDER_FLUSH_S = 0.1
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = DebugLock("msgr.net_faults")
         self._rules: list[tuple[str, str, LinkRule]] = []
         self._lanes: dict[tuple[str, int], _Lane] = {}
         self._gen = 0
@@ -426,7 +428,7 @@ class Connection:
         #: only acts where BOTH names are known — i.e. once per
         #: logical direction, at the connection-initiating end.
         self.peer_name = peer_name
-        self._send_lock = threading.Lock()
+        self._send_lock = DebugLock("msgr.send")
         self._seq = 0
         self.alive = True
         self._tx = self._rx = None
@@ -480,6 +482,14 @@ class Connection:
         return segments[0]
 
     def send(self, msg) -> None:
+        # lockdep checkpoint: a socket write is a blocking call —
+        # executing one while an op-serializing lock is held is only
+        # legitimate on the op's own (bounded) commit path, which the
+        # "messenger.send" waiver documents
+        with lockdep.blocking_region("messenger.send"):
+            self._send_faulted(msg)
+
+    def _send_faulted(self, msg) -> None:
         if net_faults.active and self.peer_name is not None:
             # outbound half of the link: the plane may drop the frame
             # (caller sees success — exactly a lost frame), defer it
@@ -595,7 +605,7 @@ class Messenger:
         self._accept_thread: threading.Thread | None = None
         self._stopping = False
         self._conns: set[Connection] = set()
-        self._lock = threading.Lock()
+        self._lock = DebugLock("msgr.conns")
         self.addr: tuple[str, int] | None = None
 
     def set_dispatcher(self, fn: Callable[[Connection, object], None]) -> None:

@@ -54,6 +54,8 @@ from .messages import (
     Pong,
 )
 from .messenger import Connection, Messenger
+from ceph_tpu.utils import lockdep
+from ceph_tpu.utils.lockdep import DebugLock, DebugRLock
 
 
 class ShardServer:
@@ -227,7 +229,7 @@ class NetShardBackend:
         self.messenger.set_dispatcher(self._dispatch)
         self._conns: dict[int, Connection] = {}
         self._tids = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = DebugLock("msgr.shard_sessions")
         self._waiting: dict[tuple[int, int], _Pending] = {}
         self._inbox: "queue.Queue[Callable[[], None]]" = queue.Queue()
         # Serializes reply-callback execution (and predicate checks)
@@ -236,7 +238,7 @@ class NetShardBackend:
         # RMW/read pipelines assume their callbacks never run
         # concurrently (crimson run-to-completion stance). RLock: a
         # callback may itself drain (sync read inside a recovery step).
-        self._cb_lock = threading.RLock()
+        self._cb_lock = DebugRLock("msgr.shard_cb")
         self._last_seen: dict[int, float] = {}
         self._hb_stop: threading.Event | None = None
         self._hb_thread: threading.Thread | None = None
@@ -437,6 +439,12 @@ class NetShardBackend:
         ``_cb_lock`` (a drainer may execute another waiter's thunk —
         the state change it was waiting on is shared, so its own
         predicate pass sees it)."""
+        with lockdep.blocking_region("peers.drain_until"):
+            self._drain_until(pred, timeout)
+
+    def _drain_until(
+        self, pred: Callable[[], bool], timeout: float
+    ) -> None:
         end = time.monotonic() + timeout
         while True:
             with self._cb_lock:

@@ -59,6 +59,8 @@ from collections import defaultdict
 from collections.abc import Callable
 
 import numpy as np
+from ceph_tpu.utils import lockdep
+from ceph_tpu.utils.lockdep import DebugLock
 
 #: slot header: op id, k, chunk count, chunk size, csum block
 #: (csum block 0 = plain encode; then the payload is [k, n*cs] flat)
@@ -141,7 +143,7 @@ class StreamingDispatcher:
         self.window_s = window_s
         self._ring = RingBuffer(capacity, slot_bytes)
         self._slot_payload = slot_bytes - _HDR.size
-        self._lock = threading.Lock()
+        self._lock = DebugLock("dispatcher.ring")
         self._next_id = 0
         #: op id -> (callback, k, chunk_len)
         self._pending: dict[int, tuple[Callable, int, int]] = {}
@@ -228,7 +230,11 @@ class StreamingDispatcher:
             ev.set()
 
         self.submit(data, cb, csum_block=csum_block, n_chunks=n_chunks)
-        ev.wait()
+        # lockdep checkpoint: waiting out a batched device dispatch is
+        # a blocking call (the "dispatcher.submit_wait" waiver covers
+        # the op path's own encode work)
+        with lockdep.blocking_region("dispatcher.submit_wait"):
+            ev.wait()
         if isinstance(out[0], BaseException):
             raise out[0]
         return out[0]
@@ -437,7 +443,7 @@ class StreamingDispatcher:
 
 # ---------------------------------------------------------------- routing
 _global: dict[tuple, StreamingDispatcher] = {}
-_global_lock = threading.Lock()
+_global_lock = DebugLock("dispatcher.registry")
 
 
 def _codec_signature(codec) -> tuple:

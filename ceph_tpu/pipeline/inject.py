@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
+from ceph_tpu.utils.lockdep import DebugLock
 
 ANY_SHARD = -1
 
@@ -78,7 +79,7 @@ class ECInject:
     """Global error-inject registry (singleton via module instance)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = DebugLock("ec.inject")
         # (kind, type, oid, shard) -> _Rule
         self._rules: dict[tuple[str, int, str, int], _Rule] = {}
         self.injected_count = 0

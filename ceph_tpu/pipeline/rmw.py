@@ -45,6 +45,7 @@ from .extents import ExtentSet
 from .hashinfo import HashInfo
 from .shard_map import ShardExtentMap
 from .stripe import StripeInfo
+from ceph_tpu.utils.lockdep import DebugRLock
 
 HINFO_KEY = "hinfo_key"  # ECUtil.cc:1179
 #: object-info attr: the rados object size travels with every shard
@@ -413,7 +414,7 @@ class RMWPipeline:
         #: shards' acks from the monitor-notify thread — both mutate
         #: pending_shards/_inflight. Reentrant: a local synchronous
         #: dispatch acks inside submit, and on_commit may re-enter.
-        self._ack_lock = threading.RLock()
+        self._ack_lock = DebugRLock("rmw.ack")
         from ceph_tpu.utils import PerfCountersBuilder, perf_collection
 
         self.perf = (

@@ -52,6 +52,7 @@ from .allocator import ALLOCATORS, AllocError
 from .devicefs import DeviceFS
 from .kvstore import DeviceKVBackend, KeyValueDB
 from .transaction import Op, OpKind, Transaction
+from ceph_tpu.utils.lockdep import DebugLock
 
 #: KV prefixes (the column-family layout, BlueStore PREFIX_* style):
 #: O = onodes, S = store-wide state (committed seq)
@@ -132,7 +133,7 @@ class BlockStore:
         self.device_path = os.path.join(root, "block")
         self.wal_path = os.path.join(root, "meta.wal")      # legacy
         self.ckpt_path = os.path.join(root, "meta.ckpt")    # legacy
-        self._lock = threading.Lock()
+        self._lock = DebugLock("store.block", rank=60)
         self.committed_seq = 0
         if not os.path.exists(self.device_path):
             with open(self.device_path, "wb") as f:

@@ -28,6 +28,7 @@ import threading
 
 from . import framed_log
 from .transaction import Op, OpKind, Transaction
+from ceph_tpu.utils.lockdep import DebugLock
 
 
 def _enc_name(oid: str) -> str:
@@ -41,7 +42,7 @@ class FileStore:
         self.objdir = os.path.join(root, "objects")
         os.makedirs(self.objdir, exist_ok=True)
         self.journal_path = os.path.join(root, "journal.wal")
-        self._lock = threading.Lock()
+        self._lock = DebugLock("store.file", rank=60)
         self.committed_seq = 0
         self._replay()
 

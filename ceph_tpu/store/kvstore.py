@@ -34,6 +34,7 @@ import struct
 import threading
 
 from . import framed_log
+from ceph_tpu.utils.lockdep import DebugLock
 
 _BATCH_HDR = struct.Struct("<I")
 _OP_HDR = struct.Struct("<BHII")
@@ -169,7 +170,7 @@ class KeyValueDB:
         self.backend = backend or FileKVBackend(root, name, sync)
         self.compact_every = compact_every
         self.sync = sync
-        self._lock = threading.Lock()
+        self._lock = DebugLock("store.kv", rank=62)
         self._table: dict[tuple[str, str], bytes] = {}
         self._wal_batches = 0
         self._load()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 
 from .transaction import Op, OpKind, Transaction
+from ceph_tpu.utils.lockdep import DebugLock
 
 
 class _Object:
@@ -36,7 +37,7 @@ class MemStore:
     def __init__(self, name: str = "memstore") -> None:
         self.name = name
         self._objects: dict[str, _Object] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("store.mem", rank=60)
         self.committed_seq = 0  # count of applied transactions
 
     # -- write path ----------------------------------------------------

@@ -123,17 +123,18 @@ def _register_builtins(sock: AdminSocket) -> None:
         lambda name=None: perf_collection.reset(name),
         "zero one named counter set, or all of them",
     )
-    def _pgmap_dump():
-        from ceph_tpu.cluster.pgmap import current_pgmap
 
-        pgmap = current_pgmap()
-        return pgmap.dump() if pgmap is not None else {}
+    from ceph_tpu.utils import lockdep
 
     sock.register(
-        "pgmap", _pgmap_dump,
-        "the PGMap aggregate (per-PG stats, pool/cluster totals, "
-        "state histogram, windowed IO/recovery rates)",
+        "lockdep", lambda: lockdep.dump(),
+        "lock-dependency graph + findings (order-inversion cycles, "
+        "rank violations, blocking-under-lock sites) from the "
+        "runtime lockdep detector",
     )
+    # (the "pgmap" command registers from cluster/pgmap.py at its own
+    # import — the admin surface must not reach UP into the cluster
+    # tier; ECLint EC101 pins the layering)
 
     sock.register(
         "log last",

@@ -211,16 +211,15 @@ def test_interval_without_client_io_still_activates(cluster):
 # -- the pinned takeover interleaving (ROADMAP #1) ----------------------
 # The loadgen-observed composition: a primary dies mid-run; writes
 # commit through the interim primary; the ex-primary returns (map-order
-# primary again). The legacy thread-and-flags peering then ran the
+# primary again). The pre-FSM thread-and-flags peering ran the
 # replica catch-up against ITSELF (peers.list_pg to its own id — an
 # RPC to nobody), failed, and reverted its own primary position to a
 # hole: committed reads answered ENOENT and the un-reconciled shard
 # tore write_full stripes around the phantom hole. ~5% per loadgen
 # roll with a primary victim; deterministic here via the peering FSM's
-# crash points (FSM path) and the always-failing self-RPC (legacy
-# path). The FSM path must survive the interleaving; the legacy
-# escape hatch must still REPRODUCE it (that is what makes it a
-# bisection hatch).
+# crash points. The legacy path (and its escape-hatch reproducer
+# test) folded out in round 16 after four rounds of green soaks —
+# the FSM surviving this interleaving is the pinned invariant.
 
 def _boot_cluster(tick_period: float):
     mon = Monitor()
@@ -310,69 +309,6 @@ def test_fsm_pins_takeover_interleaving():
         client.shutdown()
         for d in daemons:
             d.stop()
-
-
-def test_legacy_escape_hatch_reproduces_enoent_hole():
-    """Escape hatch (osd_peering_fsm=false): the SAME sequence
-    reproduces the pinned bug — the returned ex-primary lands in one
-    of the race's two terminal shapes (tick_period=0 keeps the
-    re-heal tick from papering over either):
-
-    - HOLED: the self-catch-up RPC-to-nobody failed and reverted its
-      own primary position to a hole — the committed read answers
-      ENOENT (the loadgen observable);
-    - WEDGED: the thread-and-flags election lost a wakeup and the
-      gate never opens — the committed read exhausts its retries.
-
-    Either way the committed object is unserviceable through the
-    map-order primary; on the FSM path (previous test) the identical
-    sequence serves it exactly."""
-    from ceph_tpu.cluster.osdmap import SHARD_NONE
-    from ceph_tpu.utils import config
-
-    with config.override(osd_peering_fsm=False):
-        mon, daemons, client = _boot_cluster(tick_period=0.0)
-        io = client.open_ioctx("ecpool")
-        reader = None
-        try:
-            dxp, pgid, v2 = _takeover_sequence(mon, daemons, io)
-
-            def pg_of():
-                return dxp._pgs.get(("ecpool", pgid))
-
-            def broken():
-                pg = pg_of()
-                if pg is None:
-                    return False
-                if pg.acting[0] == SHARD_NONE:
-                    return True  # holed: self catch-up failed
-                return (
-                    not pg.peered.is_set() and not pg._peering
-                )  # wedged: election died, nothing retries
-
-            assert _wait(broken, timeout=12.0), (
-                "legacy path served the takeover cleanly "
-                "(bug fixed? retire the escape hatch)"
-            )
-            time.sleep(0.3)
-            assert broken(), "transient blip, not the pinned wedge"
-            # the committed read cannot be served correctly: enoent
-            # when holed, retry exhaustion when wedged
-            from ceph_tpu.cluster.objecter import NoPrimary
-
-            reader = RadosClient(
-                mon, backoff=0.01, op_timeout=2.0, max_attempts=3
-            )
-            with pytest.raises((FileNotFoundError, IOError,
-                                TimeoutError, NoPrimary)):
-                data = reader.open_ioctx("ecpool").read("obj")
-                assert data != v2, "read served committed bytes"
-        finally:
-            if reader is not None:
-                reader.shutdown()
-            client.shutdown()
-            for d in daemons:
-                d.stop()
 
 
 def test_election_prefers_highest_les_then_lu(cluster):

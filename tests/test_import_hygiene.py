@@ -36,30 +36,43 @@ def test_no_host_crc_imports_outside_checksum():
     (checksum.crc32c_scalar / crc32c_stream record which backend ran):
     pipeline/store/msg code importing ``checksum.host`` directly would
     let the ~0.5 GB/s host path silently creep back into hot paths the
-    fused encode+csum kernel just cleared. Only checksum/ itself (and
-    tests) may touch it."""
-    import os
+    fused encode+csum kernel just cleared.
 
-    import ceph_tpu
+    Round 16: the ad-hoc source grep this test used to carry migrated
+    into ECLint's declarative EC101 rule table (tools/lint_ec.py
+    IMPORT_RULES) so the hygiene rules live in ONE place — this test
+    now drives that rule over the tree and pins that the
+    ``checksum.host`` entry is still declared."""
+    from tools.lint_ec import IMPORT_RULES, run_lint
 
-    pkg_root = os.path.dirname(ceph_tpu.__file__)
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        rel = os.path.relpath(dirpath, pkg_root)
-        if rel == "checksum" or rel.startswith("checksum" + os.sep):
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            if "checksum.host" in src or "checksum import host" in src:
-                offenders.append(os.path.relpath(path, pkg_root))
-    assert not offenders, (
-        f"checksum.host imported outside checksum/: {offenders}; "
-        "route through ceph_tpu.checksum.crc32c_scalar/crc32c_stream"
+    rule = next(
+        (r for r in IMPORT_RULES
+         if r.module == "ceph_tpu.checksum.host"), None
     )
+    assert rule is not None, (
+        "the checksum.host hygiene rule left the EC101 table"
+    )
+    assert rule.allowed == ("checksum/",)
+    res = run_lint(rules={"EC101"}, waivers_path=None)
+    offenders = [f"{f.key}: {f.message}" for f in res.findings]
+    assert not offenders, (
+        f"EC101 import-hygiene findings: {offenders}; route host CRC "
+        "through ceph_tpu.checksum.crc32c_scalar/crc32c_stream"
+    )
+
+
+def test_ec101_rule_actually_fires():
+    """Guard against the rule table rotting: a synthetic offender in
+    pipeline/ must trip the checksum.host rule."""
+    import ast
+
+    from tools.lint_ec import check_ec101
+
+    hits = check_ec101(
+        "pipeline/synthetic.py",
+        ast.parse("from ceph_tpu.checksum import host\n"),
+    )
+    assert len(hits) == 1 and "Checksummer facade" in hits[0][1]
 
 
 def test_admin_socket_first_use_still_works():

@@ -329,6 +329,14 @@ class TestCli:
         with pytest.raises(ValueError):
             parse_mix("")
 
+    def test_lockdep_flag_parses(self):
+        from ceph_tpu import bench_cli
+
+        args = bench_cli.parse_args(["loadgen", "--smoke", "--lockdep"])
+        assert args.lockdep is True
+        args = bench_cli.parse_args(["loadgen", "--smoke"])
+        assert args.lockdep is False
+
 
 # -- _op_lock poll parking (ADVICE r5 osd_daemon:1912) ------------------
 class TestPollParking:

@@ -1,0 +1,779 @@
+#!/usr/bin/env python
+"""ECLint — the repo-specific stdlib-``ast`` lint suite (EC101–EC107).
+
+Five rounds of cluster work each found a concurrency or discipline bug
+by hand that a mechanical pass should have caught (unlocked cache
+clears, blocking fan-outs under the op lock, typo'd config keys
+silently defaulting).  This linter is the mechanical pass: repo-
+specific rules over the ``ceph_tpu`` tree, run as a tier-1 test
+(``tests/test_lint.py``) and as a CLI with a pinned JSON contract.
+
+Rules
+-----
+
+======  ==============================================================
+EC101   import hygiene: a declarative rule table (``IMPORT_RULES``)
+        bans module imports outside their allowed homes — e.g.
+        ``checksum.host`` behind the Checksummer facade, and the
+        cluster tier never imported from pipeline/msg/store (the
+        layering that let ``crash_points`` move to utils in round 13)
+EC102   config discipline: every literal-key read/set/override of the
+        process config must name an option registered in
+        ``ceph_tpu/utils/config.py`` — today a typo'd key raises at
+        runtime only if the code path runs; the linter makes it a
+        build-time error
+EC103   perf-counter discipline: literal counter names passed to
+        ``.inc/.tinc/.ainc/.hinc`` must be declared by some
+        ``PerfCountersBuilder`` chain in the tree
+EC104   no bare ``threading.Lock()``/``RLock()`` in ``cluster/``,
+        ``msg/``, ``pipeline/``, ``store/`` — threaded-tier locks use
+        the lockdep wrappers (``utils/lockdep.DebugLock``) so the
+        runtime detector can see them
+EC105   determinism: the seeded planes (net-fault, crash-points,
+        loadgen spec) must not consult unseeded randomness
+        (module-level ``random.*``, argless ``random.Random()`` /
+        ``default_rng()``) or the wall clock (``time.time``)
+EC106   no ``time.sleep`` / socket calls lexically inside a
+        ``with <lock>`` block (nested defs excluded — they run later)
+EC107   no bare ``except:`` in the threaded daemon dirs — a silent
+        swallow in a worker loop eats the traceback that explains the
+        next wedged soak
+
+Waivers
+-------
+
+``tools/lint_waivers.txt`` holds reviewed exceptions, one per line::
+
+    EC106 ceph_tpu/msg/messenger.py:519  # the send lock serializes the socket
+
+The key is ``CODE path:line`` and the justification (after ``#``) is
+REQUIRED.  A waiver that matches no finding is STALE and fails the
+run — removing any single waiver line reproduces its finding, and the
+file can never drift from the tree.
+
+Usage::
+
+    python tools/lint_ec.py ceph_tpu/           # human output
+    python tools/lint_ec.py ceph_tpu/ --json    # pinned JSON contract
+    python tools/lint_ec.py --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_WAIVERS = os.path.join(REPO_ROOT, "tools", "lint_waivers.txt")
+PKG_NAME = "ceph_tpu"
+
+JSON_VERSION = 1
+
+RULES = {
+    "EC101": "import hygiene (declarative rule table)",
+    "EC102": "unregistered config-option read/set",
+    "EC103": "undeclared perf-counter name",
+    "EC104": "bare threading.Lock/RLock in the threaded tier",
+    "EC105": "unseeded randomness / wall clock in a deterministic plane",
+    "EC106": "time.sleep / socket call inside a `with <lock>` block",
+    "EC107": "bare `except:` in a daemon dir",
+}
+
+# -- EC101: the declarative import-hygiene table ---------------------------
+
+
+@dataclass(frozen=True)
+class ImportRule:
+    """One banned-import rule.  ``module`` is the dotted target (a
+    match is the module itself or any submodule); exactly one of
+    ``allowed``/``banned`` scopes it: ``allowed`` = package-relative
+    prefixes that MAY import it (everything else may not), ``banned``
+    = prefixes that may NOT (everything else may)."""
+
+    module: str
+    reason: str
+    allowed: tuple[str, ...] = ()
+    banned: tuple[str, ...] = ()
+
+
+IMPORT_RULES: tuple[ImportRule, ...] = (
+    ImportRule(
+        module="ceph_tpu.checksum.host",
+        allowed=("checksum/",),
+        reason="the ~0.5 GB/s host CRC fallback lives BEHIND the "
+               "Checksummer facade — route through "
+               "checksum.crc32c_scalar / crc32c_stream so backend "
+               "selection and its counters stay observable",
+    ),
+    ImportRule(
+        module="ceph_tpu.cluster",
+        banned=("pipeline/", "msg/", "store/", "checksum/", "codecs/",
+                "gf/", "ops/", "utils/", "parallel/", "compressor/",
+                "native/"),
+        reason="layering: the data-plane tiers must not depend on the "
+               "cluster tier (this is why crash_points moved to utils "
+               "in round 13) — invert the dependency or lift shared "
+               "state into utils/",
+    ),
+    ImportRule(
+        module="ceph_tpu.loadgen",
+        banned=("pipeline/", "msg/", "store/", "checksum/", "codecs/",
+                "gf/", "ops/", "utils/", "parallel/", "compressor/",
+                "native/", "cluster/"),
+        reason="loadgen is the test harness tier: production planes "
+               "must not import it",
+    ),
+)
+
+# -- EC104/EC105/EC106/EC107 scopes (package-relative) ---------------------
+
+LOCK_SCOPE = ("cluster/", "msg/", "pipeline/", "store/")
+#: files whose behavior must be a pure function of their seeds
+DETERMINISTIC_PLANES = (
+    "msg/messenger.py",       # net-fault plane
+    "utils/crash_points.py",  # crash-point registry
+    "loadgen/spec.py",        # workload specs / content generators
+    "loadgen/faults.py",      # fault schedules
+)
+EXCEPT_SCOPE = ("cluster/", "msg/", "pipeline/", "store/", "loadgen/")
+#: lockdep's own module constructs the primitives it wraps
+LOCK_EXEMPT_FILES = ("utils/lockdep.py",)
+
+_GLOBAL_RNG_FNS = {
+    "random", "randint", "uniform", "choice", "choices", "shuffle",
+    "randrange", "sample", "getrandbits", "gauss", "betavariate",
+    "expovariate",
+}
+_SLEEPY_CALLS = {"sleep"}
+_SOCKET_CALLS = {
+    "create_connection", "accept", "connect", "sendall", "recv",
+    "makefile",
+}
+_PERF_DECLS = {
+    "add_u64_counter", "add_u64_gauge", "add_time", "add_avg",
+    "add_histogram",
+}
+_PERF_INCS = {"inc", "tinc", "ainc", "hinc"}
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str  # repo-relative, posix
+    line: int
+    message: str
+    waived: bool = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.code} {self.path}:{self.line}"
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "key": self.key,
+            "waived": self.waived,
+        }
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    stale_waivers: list[str] = field(default_factory=list)
+    unjustified_waivers: list[str] = field(default_factory=list)
+    files_linted: int = 0
+
+    @property
+    def unwaived(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def ok(self) -> bool:
+        return not (self.unwaived or self.stale_waivers
+                    or self.unjustified_waivers)
+
+    def to_json(self) -> dict:
+        return {
+            "version": JSON_VERSION,
+            "rules": dict(RULES),
+            "files_linted": self.files_linted,
+            "findings": [f.to_json() for f in self.findings],
+            "stale_waivers": list(self.stale_waivers),
+            "unjustified_waivers": list(self.unjustified_waivers),
+            "counts": {
+                "total": len(self.findings),
+                "unwaived": len(self.unwaived),
+                "waived": len(self.findings) - len(self.unwaived),
+                "stale_waivers": len(self.stale_waivers),
+            },
+            "ok": self.ok,
+        }
+
+
+# -- shared AST helpers ----------------------------------------------------
+
+
+def _dotted(node: ast.expr) -> "str | None":
+    """`a.b.c` Attribute chain -> "a.b.c"; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_arg(call: ast.Call) -> "tuple[str, int] | None":
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value, call.args[0].lineno
+    return None
+
+
+def _module_matches(target: str, module: str) -> bool:
+    return target == module or target.startswith(module + ".")
+
+
+def _pkg_relpath(repo_rel: str) -> "str | None":
+    """'ceph_tpu/cluster/x.py' -> 'cluster/x.py'; None outside pkg."""
+    prefix = PKG_NAME + "/"
+    if repo_rel.startswith(prefix):
+        return repo_rel[len(prefix):]
+    return None
+
+
+def registered_options(config_path: "str | None" = None) -> set[str]:
+    """Option names declared in utils/config.py, extracted statically
+    (the linter must not import the package)."""
+    if config_path is None:
+        config_path = os.path.join(
+            REPO_ROOT, PKG_NAME, "utils", "config.py"
+        )
+    tree = ast.parse(open(config_path, encoding="utf-8").read())
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "Option"
+        ):
+            arg = _str_arg(node)
+            if arg is not None:
+                names.add(arg[0])
+    return names
+
+
+def declared_counters(
+    files: "list[tuple[str, ast.AST]]",
+) -> "tuple[set[str], list[str]]":
+    """Counter names declared by any builder chain in the tree:
+    (literal names, regex patterns from f-string declarations like
+    ``add_u64_counter(f"host_{op}")`` — the family IS declared, the
+    member is dynamic)."""
+    import re
+
+    names: set[str] = set()
+    patterns: list[str] = []
+    for _path, tree in files:
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _PERF_DECLS
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                dynamic = False
+                for v in arg.values:
+                    if isinstance(v, ast.Constant):
+                        parts.append(re.escape(str(v.value)))
+                    else:
+                        parts.append(".+")
+                        dynamic = True
+                pat = "".join(parts)
+                if dynamic and pat != ".+":
+                    patterns.append(f"^{pat}$")
+    return names, patterns
+
+
+# -- rule passes -----------------------------------------------------------
+
+
+def _file_package(pkg_rel: str) -> str:
+    """Dotted package of a file: 'cluster/osd_daemon.py' ->
+    'ceph_tpu.cluster'."""
+    parts = pkg_rel.split("/")[:-1]
+    return ".".join([PKG_NAME] + parts)
+
+
+def _resolve_from(node: ast.ImportFrom, file_pkg: str) -> "str | None":
+    if node.level == 0:
+        return node.module
+    base = file_pkg.split(".")
+    # level=1 -> current package, level=2 -> parent, ...
+    if node.level - 1 > len(base):
+        return None
+    base = base[: len(base) - (node.level - 1)]
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base)
+
+
+def check_ec101(pkg_rel: str, tree: ast.AST,
+                rules: tuple[ImportRule, ...] = IMPORT_RULES
+                ) -> "list[tuple[int, str]]":
+    """Returns (line, message) pairs. Exposed with an explicit rule
+    table so tests/test_import_hygiene.py drives THIS implementation
+    (the rules live in exactly one place)."""
+    out: list[tuple[int, str]] = []
+    applicable = []
+    for rule in rules:
+        if rule.allowed and any(
+            pkg_rel.startswith(p) for p in rule.allowed
+        ):
+            continue
+        if rule.banned and not any(
+            pkg_rel.startswith(p) for p in rule.banned
+        ):
+            continue
+        applicable.append(rule)
+    if not applicable:
+        return out
+    file_pkg = _file_package(pkg_rel)
+
+    seen_lines: set[tuple[int, str]] = set()
+
+    def hit(target: "str | None", lineno: int) -> bool:
+        if target is None:
+            return False
+        for rule in applicable:
+            if _module_matches(target, rule.module):
+                if (lineno, rule.module) not in seen_lines:
+                    seen_lines.add((lineno, rule.module))
+                    out.append((
+                        lineno,
+                        f"import of {target!r} is banned here: "
+                        f"{rule.reason}",
+                    ))
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                hit(alias.name, node.lineno)
+        elif isinstance(node, ast.ImportFrom):
+            mod = _resolve_from(node, file_pkg)
+            if mod is None:
+                continue
+            if not hit(mod, node.lineno):
+                for alias in node.names:
+                    hit(f"{mod}.{alias.name}", node.lineno)
+        elif isinstance(node, ast.Attribute):
+            dotted = _dotted(node)
+            if dotted and dotted.startswith(PKG_NAME + "."):
+                hit(dotted, node.lineno)
+    return out
+
+
+def _config_aliases(tree: ast.AST, file_pkg: str) -> set[str]:
+    """Local names bound to the process ConfigProxy."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        mod = _resolve_from(node, file_pkg)
+        if mod == f"{PKG_NAME}.utils.config":
+            for alias in node.names:
+                if alias.name == "config":
+                    aliases.add(alias.asname or alias.name)
+        elif mod == f"{PKG_NAME}.utils":
+            for alias in node.names:
+                if alias.name == "config":
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def check_ec102(pkg_rel: str, tree: ast.AST,
+                options: set[str]) -> "list[tuple[int, str]]":
+    if pkg_rel == "utils/config.py":
+        return []  # the registry itself
+    out: list[tuple[int, str]] = []
+    aliases = _config_aliases(tree, _file_package(pkg_rel))
+    if not aliases:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in aliases
+        ):
+            continue
+        if func.attr in ("get", "get_source", "set", "rm"):
+            arg = _str_arg(node)
+            if arg is not None and arg[0] not in options:
+                out.append((
+                    arg[1],
+                    f"config option {arg[0]!r} is not registered in "
+                    "utils/config.py (a typo here would silently "
+                    "default at runtime)",
+                ))
+        elif func.attr == "override":
+            for kw in node.keywords:
+                if kw.arg is not None and kw.arg not in options:
+                    out.append((
+                        node.lineno,
+                        f"config option {kw.arg!r} (override kwarg) "
+                        "is not registered in utils/config.py",
+                    ))
+    return out
+
+
+def check_ec103(
+    pkg_rel: str, tree: ast.AST,
+    counters: "tuple[set[str], list[str]]",
+) -> "list[tuple[int, str]]":
+    import re
+
+    names, patterns = counters
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _PERF_INCS
+        ):
+            arg = _str_arg(node)
+            if arg is None or arg[0] in names:
+                continue
+            if any(re.match(p, arg[0]) for p in patterns):
+                continue
+            out.append((
+                arg[1],
+                f"counter {arg[0]!r} is incremented but no "
+                "PerfCountersBuilder chain declares it — the inc "
+                "would raise KeyError the first time it runs",
+            ))
+    return out
+
+
+def check_ec104(pkg_rel: str, tree: ast.AST) -> "list[tuple[int, str]]":
+    if not pkg_rel.startswith(LOCK_SCOPE) or pkg_rel in LOCK_EXEMPT_FILES:
+        return []
+    out: list[tuple[int, str]] = []
+    # names bound to threading.Lock/RLock via from-import
+    from_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in ("Lock", "RLock"):
+                    from_names.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"
+            and func.attr in ("Lock", "RLock")
+        ):
+            name = f"threading.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in from_names:
+            name = func.id
+        if name is not None:
+            out.append((
+                node.lineno,
+                f"bare {name}() in the threaded tier — use "
+                "utils.lockdep.DebugLock/DebugRLock with a lock-class "
+                "name (and rank where the order is documented) so the "
+                "runtime detector can track it",
+            ))
+    return out
+
+
+def check_ec105(pkg_rel: str, tree: ast.AST) -> "list[tuple[int, str]]":
+    if pkg_rel not in DETERMINISTIC_PLANES:
+        return []
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted is None:
+            continue
+        if dotted == "time.time":
+            out.append((
+                node.lineno,
+                "wall-clock read in a deterministic plane — derive "
+                "control decisions from seeds/op offsets (timestamps "
+                "for logging are waivable)",
+            ))
+        elif dotted.startswith("random.") and \
+                dotted.split(".", 1)[1] in _GLOBAL_RNG_FNS:
+            out.append((
+                node.lineno,
+                f"{dotted}() consults the GLOBAL unseeded PRNG inside "
+                "a deterministic plane — use a per-scope "
+                "random.Random(seed)",
+            ))
+        elif dotted in ("random.Random",) and not node.args \
+                and not node.keywords:
+            out.append((
+                node.lineno,
+                "argless random.Random() (OS-seeded) in a "
+                "deterministic plane — pass an explicit seed",
+            ))
+        elif dotted.endswith("default_rng") and not node.args \
+                and not node.keywords:
+            out.append((
+                node.lineno,
+                "argless default_rng() (OS-seeded) in a deterministic "
+                "plane — pass an explicit seed",
+            ))
+    return out
+
+
+def _lockish(expr: ast.expr) -> bool:
+    """Does this with-item context look like a lock?"""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        return False  # contextmanager call, e.g. blocking_region()
+    if name is None:
+        return False
+    low = name.lower()
+    return "lock" in low or low in ("_mu", "mu", "mutex")
+
+
+def check_ec106(pkg_rel: str, tree: ast.AST) -> "list[tuple[int, str]]":
+    if not pkg_rel.startswith(EXCEPT_SCOPE):
+        return []
+    out: list[tuple[int, str]] = []
+
+    def scan_body(nodes) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # runs later, not under this lock
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                attr = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None
+                )
+                if dotted == "time.sleep" or (
+                    dotted and dotted.startswith("socket.")
+                    and dotted.split(".")[-1] in _SOCKET_CALLS
+                ) or attr in _SOCKET_CALLS:
+                    out.append((
+                        node.lineno,
+                        f"blocking call "
+                        f"{dotted or ('.' + (attr or '?'))}() lexically "
+                        "inside a `with <lock>` block — move it out, "
+                        "or waive with the justification for why this "
+                        "lock exists to serialize exactly this IO",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                scan_body([child])
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+            _lockish(item.context_expr) for item in node.items
+        ):
+            scan_body(node.body)
+    return out
+
+
+def check_ec107(pkg_rel: str, tree: ast.AST) -> "list[tuple[int, str]]":
+    if not pkg_rel.startswith(EXCEPT_SCOPE):
+        return []
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append((
+                node.lineno,
+                "bare `except:` swallows KeyboardInterrupt/SystemExit "
+                "and every traceback a wedged soak needs — catch "
+                "Exception (or narrower) and log",
+            ))
+    return out
+
+
+# -- driver ----------------------------------------------------------------
+
+
+def _iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def parse_waivers(path: str) -> "tuple[dict[str, str], list[str]]":
+    """-> ({waiver key: justification}, [keys with NO justification])."""
+    waivers: dict[str, str] = {}
+    unjustified: list[str] = []
+    if not os.path.exists(path):
+        return waivers, unjustified
+    for raw in open(path, encoding="utf-8"):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, justification = line.partition("#")
+        key = " ".join(key.split())
+        justification = justification.strip()
+        if not key:
+            continue
+        waivers[key] = justification
+        if not justification:
+            unjustified.append(key)
+    return waivers, unjustified
+
+
+def run_lint(
+    paths: "list[str] | None" = None,
+    waivers_path: "str | None" = DEFAULT_WAIVERS,
+    rules: "set[str] | None" = None,
+    import_rules: tuple[ImportRule, ...] = IMPORT_RULES,
+) -> LintResult:
+    paths = paths or [os.path.join(REPO_ROOT, PKG_NAME)]
+    result = LintResult()
+    options = registered_options()
+    parsed: list[tuple[str, ast.AST, str]] = []  # (repo_rel, tree, pkg_rel)
+    all_trees: list[tuple[str, ast.AST]] = []
+    for fp in _iter_py_files(paths):
+        repo_rel = os.path.relpath(fp, REPO_ROOT).replace(os.sep, "/")
+        try:
+            tree = ast.parse(open(fp, encoding="utf-8").read(),
+                             filename=repo_rel)
+        except SyntaxError as e:
+            result.findings.append(Finding(
+                "EC000", repo_rel, e.lineno or 0, f"syntax error: {e.msg}"
+            ))
+            continue
+        pkg_rel = _pkg_relpath(repo_rel)
+        all_trees.append((repo_rel, tree))
+        if pkg_rel is not None:
+            parsed.append((repo_rel, tree, pkg_rel))
+    result.files_linted = len(parsed)
+    counters = declared_counters(all_trees)
+
+    def want(code: str) -> bool:
+        return rules is None or code in rules
+
+    for repo_rel, tree, pkg_rel in parsed:
+        checks = []
+        if want("EC101"):
+            checks.append(("EC101",
+                           check_ec101(pkg_rel, tree, import_rules)))
+        if want("EC102"):
+            checks.append(("EC102", check_ec102(pkg_rel, tree, options)))
+        if want("EC103"):
+            checks.append(("EC103", check_ec103(pkg_rel, tree, counters)))
+        if want("EC104"):
+            checks.append(("EC104", check_ec104(pkg_rel, tree)))
+        if want("EC105"):
+            checks.append(("EC105", check_ec105(pkg_rel, tree)))
+        if want("EC106"):
+            checks.append(("EC106", check_ec106(pkg_rel, tree)))
+        if want("EC107"):
+            checks.append(("EC107", check_ec107(pkg_rel, tree)))
+        for code, hits in checks:
+            for line, message in hits:
+                result.findings.append(
+                    Finding(code, repo_rel, line, message)
+                )
+
+    waivers: dict[str, str] = {}
+    if waivers_path:
+        waivers, result.unjustified_waivers = parse_waivers(waivers_path)
+    used: set[str] = set()
+    for f in result.findings:
+        if f.key in waivers:
+            f.waived = True
+            used.add(f.key)
+    result.stale_waivers = sorted(
+        k for k in waivers
+        if k not in used and (rules is None or k.split(" ", 1)[0] in rules)
+    )
+    result.findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return result
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint_ec", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the ceph_tpu "
+                         "package)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the pinned JSON contract on stdout")
+    ap.add_argument("--waivers", default=DEFAULT_WAIVERS,
+                    help="waiver file (default tools/lint_waivers.txt); "
+                         "'none' disables waiving")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ECxxx", help="run only these rules")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for code, desc in sorted(RULES.items()):
+            print(f"{code}  {desc}")
+        return 0
+    waivers_path = None if args.waivers == "none" else args.waivers
+    result = run_lint(
+        args.paths or None,
+        waivers_path=waivers_path,
+        rules=set(args.rule) if args.rule else None,
+    )
+    if args.json:
+        print(json.dumps(result.to_json(), indent=1))
+    else:
+        for f in result.findings:
+            mark = " (waived)" if f.waived else ""
+            print(f"{f.key}{mark}\n    {f.message}")
+        for k in result.stale_waivers:
+            print(f"STALE WAIVER {k} — no finding matches; remove it")
+        for k in result.unjustified_waivers:
+            print(f"UNJUSTIFIED WAIVER {k} — add `# why` to the line")
+        n = len(result.unwaived)
+        print(
+            f"{result.files_linted} files: {len(result.findings)} "
+            f"finding(s), {n} unwaived, "
+            f"{len(result.stale_waivers)} stale waiver(s)"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

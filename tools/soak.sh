@@ -17,6 +17,16 @@
 #                            # every inter-OSD link), and the chaos
 #                            # suites join the rerun set — the
 #                            # composition PR 7 could not yet express
+#   tools/soak.sh --chaos --lockdep 10
+#                            # ALSO arm the runtime lock-order /
+#                            # blocking-under-lock detector
+#                            # (utils/lockdep.py) in both the pytest
+#                            # suites (CEPH_TPU_LOCKDEP=1) and the
+#                            # background loadgen loop (--lockdep):
+#                            # any cycle / unwaived blocking finding
+#                            # turns the lap non-green and its
+#                            # lockdep.json lands in the forensics
+#                            # bundle
 #   SOAK_SUITES="tests/test_cluster_peering.py" tools/soak.sh 20
 #   SOAK_NO_LOAD=1 tools/soak.sh 5   # skip the background load loop
 #
@@ -33,19 +43,34 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 
 CHAOS=""
-if [ "${1:-}" = "--chaos" ]; then
-    CHAOS=1
-    shift
-fi
+LOCKDEP=""
+while true; do
+    case "${1:-}" in
+        --chaos) CHAOS=1; shift ;;
+        --lockdep) LOCKDEP=1; shift ;;
+        *) break ;;
+    esac
+done
 N=${1:-50}
 DEFAULT_SUITES="tests/test_cluster_peering.py tests/test_mon_quorum.py tests/test_peering_fsm.py"
 if [ -n "$CHAOS" ]; then
     DEFAULT_SUITES="$DEFAULT_SUITES tests/test_net_faults.py tests/test_rmw_crash_points.py"
 fi
+if [ -n "$LOCKDEP" ]; then
+    DEFAULT_SUITES="$DEFAULT_SUITES tests/test_lockdep.py"
+fi
 SUITES=${SOAK_SUITES:-"$DEFAULT_SUITES"}
 LOAD_FLAGS=""
 if [ -n "$CHAOS" ]; then
     LOAD_FLAGS="--net-fault flaky"
+fi
+if [ -n "$LOCKDEP" ]; then
+    # arm the detector in the suites (env layer: every DebugLock
+    # constructed by every test becomes tracked) AND in the loadgen
+    # loop (the --lockdep flag also routes findings into the report
+    # + forensics bundle and fails non-green laps)
+    export CEPH_TPU_LOCKDEP=1
+    LOAD_FLAGS="$LOAD_FLAGS --lockdep"
 fi
 FORENSICS_DIR=${SOAK_FORENSICS_DIR:-/tmp/soak-forensics}
 SLOW_S=${SOAK_SLOW_CONVERGENCE_S:-45}
@@ -80,7 +105,7 @@ if [ -z "${SOAK_NO_LOAD:-}" ]; then
         done
     ) &
     LOAD_PID=$!
-    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)} (forensics: $FORENSICS_DIR)"
+    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}${LOCKDEP:+ (lockdep armed)} (forensics: $FORENSICS_DIR)"
 fi
 cleanup() {
     if [ -n "$LOAD_PID" ]; then
