@@ -1100,6 +1100,22 @@ def _measure_cluster(result: dict, enc_gbps: float) -> None:
         pass  # scorecard entries are best-effort; headline must print
 
 
+def _measure_qos(result: dict) -> None:
+    """Multi-tenant QoS phase (round 19): the noisy-neighbor A/B —
+    tenant A's p99 solo, under a tenant-B flood + concurrent recovery
+    with dmClock QoS armed, and the same storm with osd_op_qos=false
+    (the escape hatch) — plus the recovery-slosh curve
+    (time_to_recovered_s vs client p99 across high_client / balanced /
+    high_recovery). See loadgen/bench_phase.py:measure_qos; sized by
+    CEPH_TPU_BENCH_QOS_OPS."""
+    try:
+        from ceph_tpu.loadgen.bench_phase import measure_qos
+
+        measure_qos(result)
+    except Exception:
+        pass  # scorecard entries are best-effort; headline must print
+
+
 def _tunnel_rtt_ms() -> float | None:
     """1-byte-readback device round trip: the tunnel-health probe."""
     try:
@@ -1185,6 +1201,8 @@ def main() -> None:
         _measure_fused_write_path(result, enc_gbps)
     with _phase("cluster"):
         _measure_cluster(result, enc_gbps)
+    with _phase("qos"):
+        _measure_qos(result)
     rtt_end = _tunnel_rtt_ms()
     if rtt_end is not None:
         result["tunnel_rtt_end_ms"] = rtt_end

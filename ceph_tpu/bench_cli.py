@@ -178,6 +178,17 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                     help="tiny deterministic end-to-end run (CI "
                          "surface): smoke preset, 4 OSDs, one "
                          "kill/revive cycle")
+    lg.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant mode: run N identically-shaped "
+                         "tenants (t0..tN-1), each its own closed "
+                         "loop through a tenant-tagged IoCtx onto the "
+                         "OSDs' per-tenant mClock classes; the report "
+                         "grows per-tenant sections")
+    lg.add_argument("--qos-profile", default=None,
+                    choices=["high_client", "balanced",
+                             "high_recovery"],
+                    help="osd_mclock_profile for the run (the "
+                         "recovery-vs-client slosh knob)")
     return p.parse_args(argv)
 
 
@@ -404,8 +415,14 @@ def _run_loadgen(args) -> tuple[float, float]:
         fault_at, revive_at = args.fault_at, args.revive_at
     from ceph_tpu.utils import config as _config
 
+    if getattr(args, "tenants", 0):
+        from ceph_tpu.loadgen.spec import default_tenants
+
+        spec.tenants = default_tenants(args.tenants)
     net_fault = getattr(args, "net_fault", "none")
     overrides = dict(osd_op_coalescing=(args.coalesce == "on"))
+    if getattr(args, "qos_profile", None):
+        overrides["osd_mclock_profile"] = args.qos_profile
     if args.lockdep:
         # arm the runtime lock-order / blocking-under-lock detector
         # for this cluster (locks read the flag at construction);

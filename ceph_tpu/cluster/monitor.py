@@ -617,6 +617,56 @@ class Monitor:
                 new_pools=(replace(spec, snaps=keep),)
             )
 
+    def osd_pool_qos_set(
+        self,
+        pool: str,
+        tenant: str = "",
+        res_ops: float = 0.0,
+        res_bytes: float = 0.0,
+        weight: float = 1.0,
+        lim_ops: float = 0.0,
+        lim_bytes: float = 0.0,
+    ) -> OSDMap:
+        """Declare (or replace) one pool/tenant QoS spec — the
+        ``osd pool set <pool> qos`` surface of the multi-tenant plane
+        (cluster/qos.py).  ``tenant=""`` sets the pool-wide default
+        the untagged ``client.<pool>`` class schedules under.  The
+        spec rides the map incremental to every OSD, which re-arms
+        its mClock class live on the push."""
+        from dataclasses import replace
+
+        with self._command():
+            spec = self.osdmap.pools.get(pool)
+            if spec is None:
+                raise CommandError(f"no such pool: {pool!r}")
+            if weight <= 0.0:
+                raise CommandError("qos weight must be > 0")
+            row = (
+                str(tenant), float(res_ops), float(res_bytes),
+                float(weight), float(lim_ops), float(lim_bytes),
+            )
+            keep = tuple(q for q in spec.qos if q[0] != row[0])
+            new = replace(
+                spec, qos=tuple(sorted(keep + (row,))),
+            )
+            return self._propose(new_pools=(new,))
+
+    def osd_pool_qos_rm(self, pool: str, tenant: str = "") -> OSDMap:
+        """Drop one pool/tenant QoS spec: the tenant's class falls
+        back to the base ``client`` profile on the next map push."""
+        from dataclasses import replace
+
+        with self._command():
+            spec = self.osdmap.pools.get(pool)
+            if spec is None:
+                raise CommandError(f"no such pool: {pool!r}")
+            keep = tuple(q for q in spec.qos if q[0] != str(tenant))
+            if len(keep) == len(spec.qos):
+                raise CommandError(
+                    f"no qos spec for tenant {tenant!r}"
+                )
+            return self._propose(new_pools=(replace(spec, qos=keep),))
+
     def osd_pool_rm(self, name: str) -> OSDMap:
         with self._command():
             if name not in self.osdmap.pools:

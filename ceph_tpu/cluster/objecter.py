@@ -98,11 +98,12 @@ class _AsyncOp:
         "pool", "oid", "op", "offset", "length", "data", "name",
         "snap", "reqid", "completion", "on_complete", "attempt",
         "ambiguous", "tid", "osd", "addr", "last", "trace", "tracked",
+        "tenant",
     )
 
     def __init__(
         self, pool, oid, op, offset, length, data, name, snap, reqid,
-        on_complete,
+        on_complete, tenant="",
     ) -> None:
         self.pool = pool
         self.oid = oid
@@ -113,6 +114,7 @@ class _AsyncOp:
         self.name = name
         self.snap = snap
         self.reqid = reqid
+        self.tenant = tenant
         self.completion = Completion()
         self.on_complete = on_complete
         self.attempt = 0          # attempts started so far
@@ -310,14 +312,17 @@ class Objecter:
         name: str = "",
         snap: int = 0,
         on_complete=None,
+        tenant: str = "",
     ) -> "Completion":
         """Enqueue one op without blocking: targeting, send, retries
         and the per-attempt deadline all run off the caller's thread;
         the returned Completion resolves when the op terminally
-        succeeds or fails (callback first, then waiters)."""
+        succeeds or fails (callback first, then waiters). ``tenant``
+        rides the wire as the op's QoS identity (cluster/qos.py)."""
         aop = _AsyncOp(
             pool, oid, op, offset, length, bytes(data), name, snap,
             f"{self.client_id}.{next(self._reqs)}", on_complete,
+            tenant=tenant,
         )
         if self.perf is not None:
             with self._lock:
@@ -349,12 +354,14 @@ class Objecter:
         data: bytes = b"",
         name: str = "",
         snap: int = 0,
+        tenant: str = "",
     ) -> OSDOpReply:
         """Synchronous facade over the async engine: submit + wait.
         Raises the op's terminal error (FileNotFoundError, KeyError,
         IOError, NoPrimary) exactly like the classic blocking loop."""
         c = self.submit_async(
-            pool, oid, op, offset, length, data, name, snap
+            pool, oid, op, offset, length, data, name, snap,
+            tenant=tenant,
         )
         # generous cap: the engine already bounds every attempt with
         # op_timeout and the ladder with max_attempts — this wait only
@@ -424,7 +431,8 @@ class Objecter:
                 OSDOp(aop.tid, self.monitor.osdmap.epoch, aop.pool,
                       aop.oid, aop.op, aop.offset, aop.length, aop.data,
                       aop.name, reqid=aop.reqid, snap=aop.snap,
-                      trace_id=t_id, parent_span=t_span)
+                      trace_id=t_id, parent_span=t_span,
+                      tenant=aop.tenant)
             )
         except (ConnectionError, OSError):
             aop.last = f"osd.{aop.osd} connection failed"
@@ -581,13 +589,14 @@ class Objecter:
         length: int = 0,
         data: bytes = b"",
         on_complete=None,
+        tenant: str = "",
     ) -> Completion:
         """Asynchronous submit (rados_aio_*): alias of ``submit_async``
         kept for the librados-shaped surface; the returned Completion
         fires when the op terminally succeeds or fails."""
         return self.submit_async(
             pool, oid, op, offset, length, data,
-            on_complete=on_complete,
+            on_complete=on_complete, tenant=tenant,
         )
 
     def shutdown(self) -> None:
@@ -648,15 +657,36 @@ class Completion:
 
 
 class IoCtx:
-    """Per-pool op facade (librados IoCtx)."""
+    """Per-pool op facade (librados IoCtx).  ``tenant`` tags every op
+    submitted through this handle with a QoS identity: the OSD front
+    end schedules it under the dmClock class ``client.<tenant>``
+    (``client.<pool>`` when empty — cluster/qos.py)."""
 
-    def __init__(self, objecter: Objecter, pool: str) -> None:
+    def __init__(
+        self, objecter: Objecter, pool: str, tenant: str = ""
+    ) -> None:
         self.objecter = objecter
         self.pool = pool
+        self.tenant = tenant
+
+    # every op funnels through these three so the tenant tag never
+    # needs repeating at the ~25 librados-shaped call sites
+    def _submit(self, *args, **kw):
+        return self.objecter.submit(*args, tenant=self.tenant, **kw)
+
+    def _submit_async(self, *args, **kw):
+        return self.objecter.submit_async(
+            *args, tenant=self.tenant, **kw
+        )
+
+    def _aio_submit(self, *args, **kw):
+        return self.objecter.aio_submit(
+            *args, tenant=self.tenant, **kw
+        )
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> int:
         """Write bytes at offset; returns the new object size."""
-        return self.objecter.submit(
+        return self._submit(
             self.pool, oid, "write", offset=offset, data=bytes(data)
         ).size
 
@@ -666,7 +696,7 @@ class IoCtx:
         the daemon's op lock, so no other client observes a
         half-replaced object (the old remove+write sugar had a
         no-object window)."""
-        return self.objecter.submit(
+        return self._submit(
             self.pool, oid, "writefull", data=bytes(data)
         ).size
 
@@ -674,14 +704,14 @@ class IoCtx:
         """Append at the current size (rados_append): the offset
         resolves on the primary under its op lock, so concurrent
         appends serialize without overlap."""
-        return self.objecter.submit(
+        return self._submit(
             self.pool, oid, "append", data=bytes(data)
         ).size
 
     def truncate(self, oid: str, size: int) -> int:
         """Resize (rados_trunc): shrink cuts, grow reads back as
         zeros (hole semantics)."""
-        return self.objecter.submit(
+        return self._submit(
             self.pool, oid, "truncate", offset=size
         ).size
 
@@ -694,16 +724,16 @@ class IoCtx:
     ) -> bytes:
         """Read the head, or the object's state at a pool snapshot
         (``snap`` by name or id — rados_ioctx_snap_set_read role)."""
-        return self.objecter.submit(
+        return self._submit(
             self.pool, oid, "read", offset=offset, length=length,
             snap=self._snapid(snap),
         ).data
 
     def stat(self, oid: str) -> int:
-        return self.objecter.submit(self.pool, oid, "stat").size
+        return self._submit(self.pool, oid, "stat").size
 
     def remove(self, oid: str) -> None:
-        self.objecter.submit(self.pool, oid, "remove")
+        self._submit(self.pool, oid, "remove")
 
     # -- pool snapshots (rados_ioctx_snap_*, librados_c.cc:1749) -------
     def _spec(self):
@@ -733,7 +763,7 @@ class IoCtx:
     def snap_rollback(self, oid: str, snap: "int | str") -> None:
         """Head becomes the object's state at the snapshot
         (rados_ioctx_snap_rollback)."""
-        self.objecter.submit(
+        self._submit(
             self.pool, oid, "rollback", snap=self._snapid(snap)
         )
 
@@ -750,7 +780,7 @@ class IoCtx:
         with self.objecter._lock:
             self.objecter._watch_cbs[cookie] = callback
         try:
-            self.objecter.submit(self.pool, oid, "watch", name=cookie)
+            self._submit(self.pool, oid, "watch", name=cookie)
         except Exception:
             with self.objecter._lock:  # failed watch leaves no residue
                 self.objecter._watch_cbs.pop(cookie, None)
@@ -758,7 +788,7 @@ class IoCtx:
         return cookie
 
     def unwatch(self, oid: str, cookie: str) -> None:
-        self.objecter.submit(self.pool, oid, "unwatch", name=cookie)
+        self._submit(self.pool, oid, "unwatch", name=cookie)
         with self.objecter._lock:
             self.objecter._watch_cbs.pop(cookie, None)
 
@@ -776,7 +806,7 @@ class IoCtx:
         import json as _json
 
         cap_ms = max(int((self.objecter.op_timeout - 5.0) * 1000), 100)
-        reply = self.objecter.submit(
+        reply = self._submit(
             self.pool, oid, "notify",
             data=bytes(payload), length=min(timeout_ms, cap_ms),
         )
@@ -784,22 +814,22 @@ class IoCtx:
 
     # -- xattrs (rados_{get,set,rm}xattr + getxattrs) ------------------
     def setxattr(self, oid: str, name: str, value: bytes) -> None:
-        self.objecter.submit(
+        self._submit(
             self.pool, oid, "setxattr", data=bytes(value), name=name
         )
 
     def getxattr(self, oid: str, name: str) -> bytes:
-        return self.objecter.submit(
+        return self._submit(
             self.pool, oid, "getxattr", name=name
         ).data
 
     def rmxattr(self, oid: str, name: str) -> None:
-        self.objecter.submit(self.pool, oid, "rmxattr", name=name)
+        self._submit(self.pool, oid, "rmxattr", name=name)
 
     def getxattrs(self, oid: str) -> dict[str, bytes]:
         import json as _json
 
-        reply = self.objecter.submit(self.pool, oid, "getxattrs")
+        reply = self._submit(self.pool, oid, "getxattrs")
         return {
             k: bytes.fromhex(v)
             for k, v in _json.loads(reply.data.decode()).items()
@@ -809,7 +839,7 @@ class IoCtx:
     def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
         import json as _json
 
-        self.objecter.submit(
+        self._submit(
             self.pool, oid, "omapset",
             data=_json.dumps(
                 {k: v.hex() for k, v in kv.items()}
@@ -819,7 +849,7 @@ class IoCtx:
     def omap_rm(self, oid: str, keys: list[str]) -> None:
         import json as _json
 
-        self.objecter.submit(
+        self._submit(
             self.pool, oid, "omapset",
             data=_json.dumps({k: None for k in keys}).encode(),
         )
@@ -829,7 +859,7 @@ class IoCtx:
     ) -> dict[str, bytes]:
         import json as _json
 
-        reply = self.objecter.submit(
+        reply = self._submit(
             self.pool, oid, "omapget",
             data=_json.dumps(keys).encode() if keys is not None else b"",
         )
@@ -844,7 +874,7 @@ class IoCtx:
         """Sorted (key, value) page starting strictly after ``after``."""
         import json as _json
 
-        reply = self.objecter.submit(
+        reply = self._submit(
             self.pool, oid, "omaplist", length=max_return, name=after
         )
         return [
@@ -863,7 +893,7 @@ class IoCtx:
         if spec is None:
             raise FileNotFoundError(f"no such pool: {self.pool!r}")
         comps = [
-            self.objecter.submit_async(
+            self._submit_async(
                 self.pool, f"pg{pgid}", "pgls", offset=pgid
             )
             for pgid in range(spec.pg_num)
@@ -878,7 +908,7 @@ class IoCtx:
     def aio_write(
         self, oid: str, data: bytes, offset: int = 0, on_complete=None
     ) -> Completion:
-        return self.objecter.aio_submit(
+        return self._aio_submit(
             self.pool, oid, "write", offset=offset, data=bytes(data),
             on_complete=on_complete,
         )
@@ -886,7 +916,7 @@ class IoCtx:
     def aio_write_full(self, oid: str, data: bytes, on_complete=None
                        ) -> Completion:
         """Async full-object replace (rados_aio_write_full)."""
-        return self.objecter.aio_submit(
+        return self._aio_submit(
             self.pool, oid, "writefull", data=bytes(data),
             on_complete=on_complete,
         )
@@ -894,13 +924,13 @@ class IoCtx:
     def aio_read(
         self, oid: str, offset: int = 0, length: int = 0, on_complete=None
     ) -> Completion:
-        return self.objecter.aio_submit(
+        return self._aio_submit(
             self.pool, oid, "read", offset=offset, length=length,
             on_complete=on_complete,
         )
 
     def aio_remove(self, oid: str, on_complete=None) -> Completion:
-        return self.objecter.aio_submit(
+        return self._aio_submit(
             self.pool, oid, "remove", on_complete=on_complete
         )
 
@@ -912,10 +942,10 @@ class RadosClient:
         self.monitor = monitor
         self.objecter = Objecter(monitor, **objecter_kw)
 
-    def open_ioctx(self, pool: str) -> IoCtx:
+    def open_ioctx(self, pool: str, tenant: str = "") -> IoCtx:
         if pool not in self.monitor.osdmap.pools:
             raise FileNotFoundError(f"no such pool: {pool!r}")
-        return IoCtx(self.objecter, pool)
+        return IoCtx(self.objecter, pool, tenant=tenant)
 
     def shutdown(self) -> None:
         self.objecter.shutdown()

@@ -91,6 +91,7 @@ from ceph_tpu.utils import tracer
 from ceph_tpu.utils.lockdep import DebugLock
 from ceph_tpu.utils.mclock import MClockScheduler
 
+from . import qos as _qos
 from .osdmap import OSDMap, SHARD_NONE
 from .peering import PgPeeringFsm, crash_points, make_peering_perf
 
@@ -643,6 +644,32 @@ class OSDDaemon:
         #: through it (their IO still runs on their own threads)
         self.scheduler = MClockScheduler(scheduler_profiles)
         self._sched_cv = threading.Condition()
+        #: QoS observability: the osd.N.qos aggregate set plus lazily
+        #: created per-class osd.N.qos.pool.<label> sets. The scheduler
+        #: keeps the lifetime counts; the tick syncs them into perf by
+        #: delta so the exporter and perf dump see them.
+        self.qos_pc = _qos.make_qos_perf(f"osd.{osd_id}.qos")
+        self._qos_class_pcs: dict = {}
+        self._qos_prev: dict[str, tuple] = {}
+        self._qos_timeout_warned: set[str] = set()
+        self._tick_warn_at = float("-inf")
+        #: (stamp, cumulative client served_cost, cumulative total
+        #: served_cost, total queue depth) at the last slosh
+        #: re-derivation — the demand/capacity measurement window
+        self._qos_demand_mark: "tuple[float, float, float, int] | None" = None
+        #: measured service capacity (cost units/s): the max sustained
+        #: rate observed over BACKLOGGED tick windows, decayed so
+        #: transients fade — the osd bench auto-capacity analog.
+        #: osd_mclock_capacity is clamped to it before profiles are
+        #: derived, so notional capacities far above what the host can
+        #: actually serve cannot oversubscribe the reservation phase.
+        self._qos_cap_est: float | None = None
+        #: explicit ctor profiles pin the table: the slosh knob only
+        #: re-derives when the daemon runs on config-driven defaults
+        self._qos_static_profiles = scheduler_profiles is not None
+        #: class -> spec row last applied from pool metadata
+        self._qos_specs_applied: dict[str, tuple] = {}
+        _qos.register_scheduler(f"osd.{osd_id}", self.scheduler)
         self._worker: threading.Thread | None = None
         # op-serializing + structural locks, lockdep-tracked when the
         # `lockdep` config arms the detector (utils/lockdep.py; the
@@ -742,6 +769,9 @@ class OSDDaemon:
         self.addr = self.messenger.bind(host, port)
         self.monitor.osd_boot(self.osd_id, self.addr)
         self.monitor.subscribe(self._on_map)
+        # QoS specs already in the boot map apply now; later changes
+        # ride the map push (_on_map)
+        self._apply_qos_specs(self.osdmap)
         if self.tick_period > 0:
             self._tick_stop = threading.Event()
             self._tick_thread = threading.Thread(
@@ -848,15 +878,193 @@ class OSDDaemon:
         self._schedule(class_name, ev.set, cost)
         deadline = time.monotonic() + self.op_timeout
         while not ev.wait(timeout=0.5):
-            if self._stopped or time.monotonic() >= deadline:
+            if self._stopped:
                 return
+            if time.monotonic() >= deadline:
+                self._note_admit_timeout(class_name)
+                return
+
+    def _note_admit_timeout(self, class_name: str) -> None:
+        """An admit() wait expired and the caller proceeds
+        unthrottled. That fallback is deliberate (it beats a deadlock
+        when the worker is parked behind a lock the caller holds) but
+        it must not be silent: QoS guarantees quietly stop holding.
+        Count it per class and WRN the cluster log once per class per
+        daemon, with the locks this thread holds — the usual culprit."""
+        self.qos_pc.inc("admit_timeout")
+        self._qos_class_pc(class_name).inc("admit_timeout")
+        if class_name in self._qos_timeout_warned:
+            return
+        self._qos_timeout_warned.add(class_name)
+        from ceph_tpu.utils import lockdep
+        from ceph_tpu.utils.cluster_log import cluster_log
+
+        held = [h.lock.name for h in lockdep._held()]
+        cluster_log.log(
+            f"osd.{self.osd_id}", "qos_admit_timeout",
+            f"mclock admit for class {class_name!r} timed out after "
+            f"{self.op_timeout:.1f}s; work proceeds unthrottled "
+            f"(held locks: {held or 'none'})",
+            severity="WRN", epoch=self.osdmap.epoch,
+            qos_class=class_name,
+        )
 
     def _tick_loop(self) -> None:
         while not self._tick_stop.wait(self.tick_period):
             try:
                 self.tick()
-            except Exception:
-                pass  # a failed tick must not kill the retry loop
+            except Exception as e:
+                # a failed tick must not kill the retry loop — but a
+                # PERSISTENTLY failing tick silently stalls scrub
+                # scheduling, pool GC, re-heal and stats reporting, so
+                # it surfaces as a rate-limited cluster-log WRN
+                self._note_tick_error(e)
+
+    def _note_tick_error(self, e: BaseException) -> None:
+        import traceback
+
+        now = time.monotonic()
+        if now - self._tick_warn_at < 30.0:
+            return
+        self._tick_warn_at = now
+        tb = traceback.extract_tb(e.__traceback__)
+        where = "?"
+        if tb:
+            f = tb[-1]
+            where = f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno} in {f.name}"
+        from ceph_tpu.utils.cluster_log import cluster_log
+
+        cluster_log.log(
+            f"osd.{self.osd_id}", "tick_error",
+            f"tick failed: {type(e).__name__}: {e} (at {where})",
+            severity="WRN", epoch=self.osdmap.epoch,
+        )
+
+    # -- QoS plane upkeep ----------------------------------------------
+    def _qos_class_pc(self, class_name: str):
+        """Lazily build one class's osd.N.qos.pool.<label> perf set
+        (the exporter renders the label as a Prometheus dimension)."""
+        pc = self._qos_class_pcs.get(class_name)
+        if pc is None:
+            pc = _qos.make_qos_class_perf(
+                f"osd.{self.osd_id}.qos", class_name
+            )
+            self._qos_class_pcs[class_name] = pc
+        return pc
+
+    def _apply_qos_specs(self, osdmap: OSDMap) -> None:
+        """Install per-pool / per-tenant QoS specs carried in pool
+        metadata into the live scheduler (the map push applying an
+        ``osd pool qos set`` without a daemon restart). A tenant row
+        lands on ``client.<tenant>``; a pool-wide row (tenant "") on
+        ``client.<pool>``. Rows that left the map drop back to prefix
+        inheritance from the base ``client`` profile."""
+        want: dict[str, tuple] = {}
+        for pool, spec in osdmap.pools.items():
+            for row in getattr(spec, "qos", ()):
+                want[_qos.client_class(row[0], pool)] = tuple(row[1:])
+        if want == self._qos_specs_applied:
+            return
+        with self._sched_cv:
+            table = dict(self.scheduler.profiles)
+            for cls in set(self._qos_specs_applied) - set(want):
+                table.pop(cls, None)
+            for cls, row in want.items():
+                table[cls] = _qos.QoSSpec(*row).to_profile()
+            self.scheduler.set_profiles(table)
+        self._qos_specs_applied = want
+
+    def _qos_tick(self) -> None:
+        """Per-tick QoS upkeep: sync the scheduler's per-class service
+        counts into the osd.N.qos perf sets (delta-based — the
+        scheduler counts, perf exposes) and turn the slosh knob:
+        re-derive the base profile table from osd_mclock_profile /
+        osd_mclock_capacity with client demand measured over the tick
+        window, so reservation capacity idle clients aren't using
+        flows to recovery and backfill."""
+        from ceph_tpu.utils import config as _cfg
+
+        with self._sched_cv:
+            snap = self.scheduler.dump()
+        total_depth, worst_lag = 0, 0.0
+        client_cost = total_cost = 0.0
+        for cls, st in snap.items():
+            total_depth += st["depth"]
+            worst_lag = max(worst_lag, st["tag_lag_s"])
+            total_cost += st["served_cost"]
+            if cls == "client" or cls.startswith("client."):
+                client_cost += st["served_cost"]
+            prev = self._qos_prev.get(cls, (0, 0, 0))
+            d_r = st["dequeued_r"] - prev[0]
+            d_p = st["dequeued_p"] - prev[1]
+            d_t = st["throttled"] - prev[2]
+            self._qos_prev[cls] = (
+                st["dequeued_r"], st["dequeued_p"], st["throttled"]
+            )
+            if d_r:
+                self.qos_pc.inc("dequeue_r", d_r)
+            if d_p:
+                self.qos_pc.inc("dequeue_p", d_p)
+            if d_t:
+                self.qos_pc.inc("throttle", d_t)
+            cpc = self._qos_class_pc(cls)
+            if d_r or d_p:
+                cpc.inc("dequeue", d_r + d_p)
+            if d_t:
+                cpc.inc("throttle", d_t)
+            cpc.set("queue_depth", st["depth"])
+        self.qos_pc.set("queue_depth", total_depth)
+        self.qos_pc.set("tag_lag_ms", int(worst_lag * 1000))
+        self.qos_pc.set("qos_classes", len(snap))
+        if self._qos_static_profiles:
+            return  # explicit ctor profiles: the caller owns the table
+        now = time.monotonic()
+        mark = self._qos_demand_mark
+        self._qos_demand_mark = (now, client_cost, total_cost,
+                                 total_depth)
+        demand = 0.0
+        if mark is not None and now > mark[0]:
+            dt = now - mark[0]
+            demand = max(client_cost - mark[1], 0.0) / dt
+            # capacity estimate: only windows that STARTED backlogged
+            # measure the server (an idle window's low rate is demand,
+            # not capacity); decay so a one-off fast window fades
+            if mark[3] > 0:
+                rate = max(total_cost - mark[2], 0.0) / dt
+                est = self._qos_cap_est
+                self._qos_cap_est = (
+                    rate if est is None else max(rate, 0.9 * est)
+                )
+        capacity = _cfg.get("osd_mclock_capacity")
+        # The measured estimate bounds ONLY the reservation clock (the
+        # admission guard below): oversubscribed floors starve the
+        # weight phase. Limits keep the configured capacity — a
+        # cratered estimate throttling the limit-fraction classes
+        # would depress the measured rate and lock itself low, since
+        # a weak floor slows nothing but a tight ceiling does.
+        admit_cap = capacity
+        if self._qos_cap_est is not None:
+            admit_cap = min(capacity, max(self._qos_cap_est, 1.0))
+        self.qos_pc.set("capacity", int(admit_cap))
+        try:
+            table = _qos.derive_profiles(
+                _cfg.get("osd_mclock_profile"),
+                capacity,
+                client_demand=demand,
+            )
+        except ValueError:
+            return  # a bad profile name must not kill the tick
+        # spec rows pushed from pool metadata ride on top of the
+        # derived base table (from the pristine rows, NOT the live
+        # profiles — those may already be normalization-scaled), then
+        # the sum(reservations) <= frac * admit_cap admission guard
+        # rescales the reservation clocks against what the host is
+        # measured to actually serve
+        for cls, row in self._qos_specs_applied.items():
+            table[cls] = _qos.QoSSpec(*row).to_profile()
+        table = _qos.normalize_reservations(table, admit_cap)
+        with self._sched_cv:
+            self.scheduler.set_profiles(table)
 
     def stop(self) -> None:
         self._stopped = True
@@ -937,6 +1145,7 @@ class OSDDaemon:
             # map must not revert newer values) and under _pg_lock so
             # concurrent deliveries can't interleave apply/rm
             self._apply_mon_config(osdmap)
+            self._apply_qos_specs(osdmap)
             # pool identity is the ID (names are reusable, ids never
             # are) — and deletions accumulate so a skipped epoch or a
             # straggler write can't leak keys forever
@@ -1223,7 +1432,11 @@ class OSDDaemon:
                     pg.pool, pg.pgid, spec, pg, exclude=target_osd
                 )
                 for loc in sorted(hints):
-                    self.admit("recovery")
+                    # byte-proportional: a 4 MB refresh consumes ~65x
+                    # the recovery budget of a 4 KB one
+                    self.admit(
+                        "recovery", cost=_qos.op_cost(max(hints[loc], 0))
+                    )
                     size = self._object_size(pg, loc)
                     known = bool(size) or self._have_object(pg, loc)
                     size_hint = None
@@ -1282,7 +1495,10 @@ class OSDDaemon:
             # pre-refresh stamps are stale by construction)
             rollback -= refreshed
             for loc in sorted(rollback):
-                self.admit("recovery")
+                self.admit(
+                    "recovery",
+                    cost=_qos.op_cost(self._object_size(pg, loc)),
+                )
                 self.log.info(
                     "pg", f"{pg.pool}/{pg.pgid}:", "divergent object",
                     loc, "on shard", shard, "- rolling back"
@@ -1940,8 +2156,19 @@ class OSDDaemon:
                 name="notify", daemon=True,
             ).start()
             return
-        cost = 1.0 + max(len(msg.data), msg.length) / 65536.0
-        self._schedule("client", _ClientOpItem(self, conn, msg), cost)
+        from ceph_tpu.utils import config as _cfg
+
+        cost = _qos.op_cost(max(len(msg.data), msg.length))
+        # multi-tenant classing: a tagged op queues under its tenant's
+        # own mClock clocks (client.<tenant>), an untagged one under
+        # its pool's (client.<pool>) — the flooding neighbor throttles
+        # against its own tags. osd_op_qos=false is the escape hatch:
+        # everything shares the flat "client" class again.
+        cls = (
+            _qos.client_class(msg.tenant, msg.pool)
+            if _cfg.get("osd_op_qos") else "client"
+        )
+        self._schedule(cls, _ClientOpItem(self, conn, msg), cost)
 
     def _run_client_op(self, conn: Connection, msg: OSDOp) -> None:
         try:
@@ -3574,6 +3801,7 @@ class OSDDaemon:
                 pg.fsm.post_interval()
                 continue
             self._spawn_catch_up(pg, shard)
+        self._qos_tick()
         self.report_pg_stats()
 
     # -- PG-stats reporting (the MPGStats sender) -----------------------
@@ -4056,8 +4284,11 @@ class OSDDaemon:
             )
             for oid in sorted(hints):
                 # QoS: each object move admits through the backfill
-                # class so client IO keeps its reservation
-                self.admit("backfill")
+                # class, at byte-proportional cost, so client IO keeps
+                # its reservation
+                self.admit(
+                    "backfill", cost=_qos.op_cost(max(hints[oid], 0))
+                )
                 # clear the dirty flag BEFORE pushing: a client write
                 # landing mid-push re-marks it and the final pass
                 # re-pushes; discarding after would erase that evidence
@@ -4261,7 +4492,11 @@ class OSDDaemon:
         locs = sorted(self._backfill_scan(pool, pgid, spec, pg))
         results = []
         for loc in locs:
-            self.admit("scrub")
+            # deep scrub reads every live shard's payload: price the
+            # sweep by object size, not per-object flat
+            self.admit(
+                "scrub", cost=_qos.op_cost(self._object_size(pg, loc))
+            )
             # serialize with client ops: a scrub racing a mid-commit
             # write would see mixed-epoch shards and (with repair)
             # write the mixture back

@@ -92,6 +92,12 @@ class PoolSpec:
     #: (pg_pool_t snaps); snap_seq is the next id to issue
     snaps: tuple[tuple[int, str, int], ...] = ()
     snap_seq: int = 0
+    #: per-tenant QoS declarations riding the map to every OSD
+    #: (cluster/qos.py QoSSpec rows): ((tenant, res_ops, res_bytes,
+    #: weight, lim_ops, lim_bytes), ...) ascending by tenant; the
+    #: ``""`` tenant is the pool-wide default (the class
+    #: ``client.<pool>`` untagged ops fall back to)
+    qos: tuple[tuple[str, float, float, float, float, float], ...] = ()
 
     @property
     def size(self) -> int:
@@ -116,6 +122,7 @@ class PoolSpec:
             "crush_rule": self.crush_rule,
             "snaps": [list(s) for s in self.snaps],
             "snap_seq": self.snap_seq,
+            "qos": [list(q) for q in self.qos],
         }
 
     @classmethod
@@ -126,6 +133,7 @@ class PoolSpec:
             o.get("crush_rule", ""),
             tuple(tuple(s) for s in o.get("snaps", ())),
             o.get("snap_seq", 0),
+            tuple(tuple(q) for q in o.get("qos", ())),
         )
 
 

@@ -26,7 +26,7 @@ mid-run to measure degraded-window throughput and time-to-recovered.
 """
 
 from .cluster import LoadCluster
-from .driver import LoadGenerator, run_spec
+from .driver import LoadGenerator, run_multi_tenant, run_spec
 from .faults import FaultEvent, FaultSchedule
 from .forensics import run_is_green, write_bundle
 from .histogram import Log2Histogram
@@ -36,11 +36,13 @@ from .spec import (
     PRESETS,
     Popularity,
     WorkloadSpec,
+    default_tenants,
     expected_image,
     object_bytes,
     parse_mix,
     patch_bytes,
     preset,
+    tenant_specs,
 )
 
 __all__ = [
@@ -55,12 +57,15 @@ __all__ = [
     "Popularity",
     "RunRecorder",
     "WorkloadSpec",
+    "default_tenants",
     "expected_image",
     "object_bytes",
     "parse_mix",
     "patch_bytes",
     "preset",
     "run_is_green",
+    "run_multi_tenant",
     "run_spec",
+    "tenant_specs",
     "write_bundle",
 ]

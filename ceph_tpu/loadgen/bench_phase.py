@@ -260,3 +260,141 @@ def measure_cluster(result: dict, enc_gbps: float) -> None:
         )
         result[f"cluster_scale_chips{chips}_gbps"] = rep["gbps"]
         result[f"cluster_scale_chips{chips}_iops"] = rep["iops"]
+
+
+# -- the round-19 QoS phase: noisy neighbor + recovery slosh ------------
+#: tenant A: a modest latency-sensitive mix with a reservation-bearing
+#: QoS spec — the tenant whose p99 the plane must defend
+_TENANT_A = {
+    "mix": {"seq_write": 1, "read": 3, "rmw_overwrite": 1},
+    "object_size": 64 * 1024,
+    "qos": {"res_ops": 64.0, "res_bytes": 8 << 20, "weight": 4.0},
+}
+#: tenant B: the write-heavy flood (big objects, deep queue) whose
+#: cost-tagged ops must throttle against B's OWN clocks
+_TENANT_B = {
+    "mix": {"seq_write": 3, "rand_write": 2},
+    "object_size": 512 * 1024,
+    "qos": {"weight": 1.0},
+}
+
+
+def qos_leg(
+    total_ops: int,
+    qd: int,
+    max_objects: int,
+    *,
+    flood: bool = False,
+    faults: bool = False,
+    qos_on: bool = True,
+    profile: str = "balanced",
+    device_clock: bool = False,
+    seed: int = 0x905,
+) -> dict:
+    """One multi-tenant leg: tenant A's modest mix, optionally tenant
+    B's flood on top, optionally a mid-run most-primary kill/revive
+    (recovery competing with clients), under one slosh-knob profile.
+    ``qos_on=False`` is the escape hatch — every op back on the flat
+    shared class."""
+    from ceph_tpu.utils import config as _cfg
+
+    tenants: dict = {"tenantA": dict(_TENANT_A)}
+    tenants["tenantA"]["queue_depth"] = max(qd // 4, 2)
+    tenants["tenantA"]["total_ops"] = total_ops
+    if flood:
+        tenants["tenantB"] = dict(_TENANT_B)
+        tenants["tenantB"]["queue_depth"] = qd
+        tenants["tenantB"]["total_ops"] = total_ops * 2
+    with _cfg.override(osd_op_qos=qos_on, osd_mclock_profile=profile):
+        cluster = LoadCluster(
+            n_osds=6, k=4, m=2, pg_num=8, chunk_size=16384,
+        )
+        try:
+            spec = WorkloadSpec(
+                mix=dict(_MIX),
+                object_size=64 * 1024,
+                max_objects=max_objects,
+                queue_depth=qd,
+                total_ops=total_ops,
+                warmup_ops=max(total_ops // 10, 8),
+                popularity="zipfian",
+                device_clock=device_clock,
+                seed=seed,
+                tenants=tenants,
+            )
+            schedule = None
+            if faults:
+                # kill the most-primary OSD a third in, revive at two
+                # thirds: recovery work overlaps the measured window
+                schedule = FaultSchedule(
+                    [
+                        FaultEvent(at_op=total_ops // 3, action="kill"),
+                        FaultEvent(at_op=(2 * total_ops) // 3,
+                                   action="revive"),
+                    ]
+                )
+            return run_spec(cluster, spec, schedule)
+        finally:
+            cluster.shutdown()
+
+
+def measure_qos(result: dict) -> None:
+    """The noisy-neighbor A/B row and the recovery-slosh curve.
+
+    - ``qos_tenantA_p99_{solo,noisy,noqos}_ms``: tenant A's p99 alone,
+      under a tenant-B flood + concurrent recovery with QoS armed, and
+      the same storm with ``osd_op_qos=false`` (the escape hatch must
+      demonstrably blow past the bound or the A/B proves nothing);
+      ``qos_noisy_neighbor_frac`` / ``qos_escape_hatch_frac`` are the
+      degradations vs solo.
+    - ``qos_slosh_<profile>_{recovery_s,p99_ms}``: time-to-recovered
+      vs tenant-A p99 across the three slosh-knob settings — the knob
+      must trade them monotonically.
+
+    Sized by CEPH_TPU_BENCH_QOS_OPS / _QD (defaults 160 / 16)."""
+    total_ops = int(os.environ.get("CEPH_TPU_BENCH_QOS_OPS", "160"))
+    qd = int(os.environ.get("CEPH_TPU_BENCH_QOS_QD", "16"))
+    max_objects = 64
+
+    solo = qos_leg(total_ops, qd, max_objects, seed=0x905)
+    noisy = qos_leg(
+        total_ops, qd, max_objects, flood=True, faults=True,
+        seed=0x905,
+    )
+    noqos = qos_leg(
+        total_ops, qd, max_objects, flood=True, faults=True,
+        qos_on=False, seed=0x905,
+    )
+    rows = {"solo": solo, "noisy": noisy, "noqos": noqos}
+    a_p99: dict[str, float] = {}
+    for name, rep in rows.items():
+        a = rep.get("tenants", {}).get("tenantA", {})
+        p99 = a.get("lat_p99_ms")
+        if p99 is not None:
+            a_p99[name] = p99
+            result[f"qos_tenantA_p99_{name}_ms"] = p99
+        result[f"qos_{name}_verify_failures"] = rep.get(
+            "verify_failures", -1
+        )
+    if a_p99.get("solo"):
+        if "noisy" in a_p99:
+            result["qos_noisy_neighbor_frac"] = round(
+                a_p99["noisy"] / a_p99["solo"], 4
+            )
+        if "noqos" in a_p99:
+            result["qos_escape_hatch_frac"] = round(
+                a_p99["noqos"] / a_p99["solo"], 4
+            )
+
+    # the slosh curve: one recovery-under-load leg per knob setting
+    for prof in ("high_client", "balanced", "high_recovery"):
+        rep = qos_leg(
+            total_ops, qd, max_objects, faults=True, profile=prof,
+            seed=0x5105,
+        )
+        ttr = rep.get("fault", {}).get("time_to_recovered_s")
+        if ttr is not None:
+            result[f"qos_slosh_{prof}_recovery_s"] = ttr
+        a = rep.get("tenants", {}).get("tenantA", {})
+        if a.get("lat_p99_ms") is not None:
+            result[f"qos_slosh_{prof}_p99_ms"] = a["lat_p99_ms"]

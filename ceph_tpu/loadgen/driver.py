@@ -77,10 +77,16 @@ class LoadGenerator:
         cluster,
         spec: WorkloadSpec,
         fault_schedule: FaultSchedule | None = None,
+        io=None,
+        perf_name: str = "loadgen",
     ) -> None:
         self.cluster = cluster
         self.spec = spec
         self.faults = fault_schedule
+        #: the IoCtx ops go through — a tenant run passes its own
+        #: tenant-tagged ioctx so every op carries the tenant id
+        self.io = io if io is not None else cluster.io
+        self._perf_name = perf_name
         self.recorder = RunRecorder(warmup_ops=spec.warmup_ops)
         self._op_seq = 0
         self._ops_done = 0
@@ -115,7 +121,7 @@ class LoadGenerator:
         from .histogram import Log2Histogram
         from .spec import OP_CLASSES
 
-        b = PerfCountersBuilder(perf_collection, "loadgen")
+        b = PerfCountersBuilder(perf_collection, self._perf_name)
         for cls in OP_CLASSES:
             b.add_u64_counter(f"ops_{cls}", f"completed {cls} ops")
         bounds, _ = Log2Histogram().perf_buckets()
@@ -212,7 +218,7 @@ class LoadGenerator:
                 self.spec.seed, idx, st.version, self.spec.object_size
             )
             try:
-                size = self.cluster.io.write_full(
+                size = self.io.write_full(
                     self._oid(idx), data
                 )
             except Exception:
@@ -238,7 +244,7 @@ class LoadGenerator:
                 self.spec.seed, idx, st.version, self.spec.object_size
             )
             try:
-                size = self.cluster.io.write_full(
+                size = self.io.write_full(
                     self._oid(idx), data
                 )
             except Exception:
@@ -264,7 +270,7 @@ class LoadGenerator:
             idx = live[self._pick.pick(rng, len(live)) % len(live)]
         st = self._obj(idx)
         with st.lock:
-            got = self.cluster.io.read(self._oid(idx))
+            got = self.io.read(self._oid(idx))
             good = self._verify(idx, got, st.version, st.n_patches)
         if not good:
             self._pc_inc("verify_failed")
@@ -284,7 +290,7 @@ class LoadGenerator:
                 self.spec.object_size, self.spec.rmw_max_len,
             )
             try:
-                self.cluster.io.write(
+                self.io.write(
                     self._oid(idx), payload, offset=off
                 )
             except Exception:
@@ -379,7 +385,7 @@ class LoadGenerator:
                     self.spec.object_size,
                 )
                 ctx["nbytes"] = len(data)
-                self.cluster.io.aio_write_full(
+                self.io.aio_write_full(
                     oid, data, on_complete=done
                 )
             elif cls == "rmw_overwrite":
@@ -390,13 +396,13 @@ class LoadGenerator:
                 )
                 ctx["patch_no"] = patch_no
                 ctx["nbytes"] = len(payload)
-                self.cluster.io.aio_write(
+                self.io.aio_write(
                     oid, payload, offset=off, on_complete=done
                 )
             else:  # read / reconstruct_read
                 ctx["version"] = st.version
                 ctx["n_patches"] = st.n_patches
-                self.cluster.io.aio_read(oid, on_complete=done)
+                self.io.aio_read(oid, on_complete=done)
         except Exception as e:
             # submission itself failed: finish the op inline (exactly
             # one ledger slot either way)
@@ -661,5 +667,94 @@ def run_spec(
     cluster, spec: WorkloadSpec,
     fault_schedule: FaultSchedule | None = None,
 ) -> dict:
-    """Convenience: drive ``spec`` on ``cluster`` and report."""
+    """Convenience: drive ``spec`` on ``cluster`` and report. A spec
+    with ``tenants`` fans out to one closed loop per tenant."""
+    if spec.tenants:
+        return run_multi_tenant(cluster, spec, fault_schedule)
     return LoadGenerator(cluster, spec, fault_schedule).run()
+
+
+def run_multi_tenant(
+    cluster, spec: WorkloadSpec,
+    fault_schedule: FaultSchedule | None = None,
+) -> dict:
+    """Multi-tenant run: one LoadGenerator per tenant, concurrently,
+    each through its OWN tenant-tagged IoCtx (the ops carry the tenant
+    onto the OSDs' per-tenant mClock classes), its own recorder and a
+    ``loadgen.pool.<tenant>`` perf set (the exporter's tenant label).
+    A tenant's ``qos`` override installs its QoSSpec on the pool via
+    the monitor BEFORE load starts, so the run exercises the pushed
+    spec. The fault schedule is driven by the first tenant's op stream
+    (exactly one thrash driver — double-firing kills would double the
+    chaos). Report: per-tenant sections under ``tenants`` plus
+    cluster-wide aggregates."""
+    from .spec import tenant_specs
+
+    per_tenant = tenant_specs(spec)
+    mon = getattr(cluster, "mon", None)
+    for tenant, (_tspec, qos) in per_tenant.items():
+        if qos and mon is not None:
+            mon.osd_pool_qos_set(cluster.pool, tenant=tenant, **qos)
+    first = min(per_tenant) if per_tenant else None
+    gens: dict[str, LoadGenerator] = {}
+    for tenant, (tspec, _qos) in per_tenant.items():
+        gens[tenant] = LoadGenerator(
+            cluster, tspec,
+            fault_schedule if tenant == first else None,
+            io=cluster.client.open_ioctx(cluster.pool, tenant=tenant),
+            perf_name=f"loadgen.pool.{tenant}",
+        )
+    reports: dict[str, dict] = {}
+    errs: list = []
+
+    def _one(tenant: str) -> None:
+        try:
+            reports[tenant] = gens[tenant].run()
+        except Exception as e:  # surfaced in the aggregate, not lost
+            errs.append(f"{tenant}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(
+            target=_one, args=(t,), daemon=True,
+            name=f"loadgen-tenant-{t}",
+        )
+        for t in sorted(gens)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out: dict = {
+        "tenants": {t: reports[t] for t in sorted(reports)},
+        "duration_s": max(
+            (r["duration_s"] for r in reports.values()), default=0.0
+        ),
+        "iops": round(
+            sum(r["iops"] for r in reports.values()), 1
+        ),
+        "ops": sum(r["ops"] for r in reports.values()),
+        "ops_in": sum(r["ops_in"] for r in reports.values()),
+        "ops_accounted": sum(
+            r["ops_accounted"] for r in reports.values()
+        ),
+        "bytes": sum(r["bytes"] for r in reports.values()),
+        "gbps": round(
+            sum(r["gbps"] for r in reports.values()), 6
+        ),
+        "verify_failures": sum(
+            r["verify_failures"] for r in reports.values()
+        ),
+        "errors": sum(r["errors"] for r in reports.values()),
+        "exactly_once": bool(reports) and all(
+            r["exactly_once"] for r in reports.values()
+        ),
+    }
+    if errs:
+        out["error_samples"] = errs[:10]
+        out["exactly_once"] = False
+    for r in reports.values():
+        for key in ("fault", "recovered", "pg_states",
+                    "status_digest", "degraded_objects"):
+            if key in r and key not in out:
+                out[key] = r[key]
+    return out

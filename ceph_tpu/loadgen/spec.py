@@ -83,6 +83,16 @@ class WorkloadSpec:
     #: report (``report["traces"]``: span trees + critical paths +
     #: Chrome trace JSON — utils/trace_assembly.py); 0 = off
     trace_capture: int = 0
+    #: multi-tenant mode: tenant name -> override dict. Each tenant
+    #: runs its OWN closed loop (own IoCtx tagged with the tenant, own
+    #: recorder/histograms, own oid namespace via a derived seed) with
+    #: any of this spec's fields overridden per tenant — ``mix`` (dict
+    #: or parse_mix string), ``object_size``, ``queue_depth``,
+    #: ``total_ops``, ... — plus an optional ``qos`` key: a QoSSpec
+    #: field dict installed on the pool for that tenant before the run
+    #: (reservation/weight/limit in ops/s and bytes/s). Empty dict =
+    #: classic single-tenant run.
+    tenants: dict = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         for name in self.mix:
@@ -103,6 +113,45 @@ class WorkloadSpec:
                 f"popularity must be uniform|zipfian, "
                 f"got {self.popularity!r}"
             )
+
+
+def tenant_specs(
+    spec: WorkloadSpec,
+) -> "dict[str, tuple[WorkloadSpec, dict | None]]":
+    """Explode a multi-tenant spec into per-tenant sub-specs:
+    ``tenant -> (spec, qos)`` where ``qos`` is the tenant's QoSSpec
+    field dict (or None). Each sub-spec inherits every base field,
+    applies the tenant's overrides, and derives a per-tenant seed so
+    oid namespaces (``lg-<seed>-<idx>``), contents and op sequences
+    never collide across tenants."""
+    import zlib
+    from dataclasses import fields as _fields
+
+    base = {
+        f.name: getattr(spec, f.name)
+        for f in _fields(spec) if f.name != "tenants"
+    }
+    out: dict[str, tuple[WorkloadSpec, dict | None]] = {}
+    for tenant in sorted(spec.tenants):
+        ov = dict(spec.tenants[tenant] or {})
+        qos = ov.pop("qos", None)
+        if isinstance(ov.get("mix"), str):
+            ov["mix"] = parse_mix(ov["mix"])
+        kw = dict(base)
+        kw["seed"] = (
+            spec.seed ^ (zlib.crc32(tenant.encode()) & 0x7FFFFF)
+        )
+        kw.update(ov)
+        out[tenant] = (WorkloadSpec(**kw), qos)
+    return out
+
+
+def default_tenants(n: int) -> dict:
+    """``--tenants N``: N identically-shaped tenants t0..t{N-1}
+    (per-tenant knobs come from explicit ``tenants=`` specs)."""
+    if n < 1:
+        raise ValueError("tenants must be >= 1")
+    return {f"t{i}": {} for i in range(n)}
 
 
 class Popularity:

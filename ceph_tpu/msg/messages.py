@@ -348,6 +348,10 @@ class OSDOp:
     #: version-tolerant
     trace_id: str | None = None
     parent_span: str | None = None
+    #: QoS identity (the MOSDOp entity/client role): the OSD front end
+    #: schedules the op under the dmClock class ``client.<tenant>``,
+    #: falling back to ``client.<pool>`` when empty (cluster/qos.py)
+    tenant: str = ""
 
     def encode(self) -> list[bytes]:
         return [
@@ -368,6 +372,7 @@ class OSDOp:
                         {"trace": [self.trace_id, self.parent_span]}
                         if self.trace_id is not None else {}
                     ),
+                    **({"tenant": self.tenant} if self.tenant else {}),
                 },
             ),
             self.data,
@@ -381,7 +386,7 @@ class OSDOp:
             h["tid"], h["epoch"], h["pool"], h["oid"], h["op"],
             h["offset"], h["length"], segments[1], h.get("name", ""),
             h.get("reqid", ""), h.get("snap", 0),
-            trace[0], trace[1],
+            trace[0], trace[1], h.get("tenant", ""),
         )
 
 

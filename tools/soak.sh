@@ -27,6 +27,14 @@
 #                            # turns the lap non-green and its
 #                            # lockdep.json lands in the forensics
 #                            # bundle
+#   tools/soak.sh --qos 10   # multi-tenant QoS leg: the background
+#                            # loadgen loop runs TWO tenants (the
+#                            # dmClock per-tenant classes, tenant-
+#                            # tagged wire ops and per-tenant
+#                            # exactly-once accounting all under the
+#                            # kill/revive churn), and the mclock/qos
+#                            # suites join the rerun set; composes
+#                            # with --chaos/--lockdep
 #   SOAK_SUITES="tests/test_cluster_peering.py" tools/soak.sh 20
 #   SOAK_NO_LOAD=1 tools/soak.sh 5   # skip the background load loop
 #
@@ -44,10 +52,12 @@ cd "$(dirname "$0")/.."
 
 CHAOS=""
 LOCKDEP=""
+QOS=""
 while true; do
     case "${1:-}" in
         --chaos) CHAOS=1; shift ;;
         --lockdep) LOCKDEP=1; shift ;;
+        --qos) QOS=1; shift ;;
         *) break ;;
     esac
 done
@@ -59,10 +69,18 @@ fi
 if [ -n "$LOCKDEP" ]; then
     DEFAULT_SUITES="$DEFAULT_SUITES tests/test_lockdep.py"
 fi
+if [ -n "$QOS" ]; then
+    DEFAULT_SUITES="$DEFAULT_SUITES tests/test_mclock.py tests/test_qos.py"
+fi
 SUITES=${SOAK_SUITES:-"$DEFAULT_SUITES"}
 LOAD_FLAGS=""
 if [ -n "$CHAOS" ]; then
     LOAD_FLAGS="--net-fault flaky"
+fi
+if [ -n "$QOS" ]; then
+    # two-tenant smoke: per-tenant classes + tagged wire ops under
+    # the same primary-kill churn (and net_flaky, when composed)
+    LOAD_FLAGS="$LOAD_FLAGS --tenants 2"
 fi
 if [ -n "$LOCKDEP" ]; then
     # arm the detector in the suites (env layer: every DebugLock
@@ -105,7 +123,7 @@ if [ -z "${SOAK_NO_LOAD:-}" ]; then
         done
     ) &
     LOAD_PID=$!
-    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}${LOCKDEP:+ (lockdep armed)} (forensics: $FORENSICS_DIR)"
+    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}${LOCKDEP:+ (lockdep armed)}${QOS:+ (qos: 2 tenants)} (forensics: $FORENSICS_DIR)"
 fi
 cleanup() {
     if [ -n "$LOAD_PID" ]; then
