@@ -1116,6 +1116,24 @@ def _measure_qos(result: dict) -> None:
         pass  # scorecard entries are best-effort; headline must print
 
 
+def _measure_transport(result: dict, enc_gbps: float) -> None:
+    """Messenger-v2 transport phase (round 20): the within-run
+    transport x codec A/B grid (tcp/shm_ring x python/native frame
+    codec) with a per-leg cluster-vs-kernel fraction, the shm-ring
+    lane headline (shm_ring_gbps + chunk/byte traffic proof), the
+    native-codec speedup, and the op-shard head-of-line rows — the
+    flood x kill latency-spread ladder at 1 vs 4 shards plus the
+    deterministic parked-shard sibling probe. See
+    loadgen/bench_phase.py:measure_transport; sized by
+    CEPH_TPU_BENCH_TRANSPORT_OPS."""
+    try:
+        from ceph_tpu.loadgen.bench_phase import measure_transport
+
+        measure_transport(result, enc_gbps)
+    except Exception:
+        pass  # scorecard entries are best-effort; headline must print
+
+
 def _tunnel_rtt_ms() -> float | None:
     """1-byte-readback device round trip: the tunnel-health probe."""
     try:
@@ -1203,6 +1221,8 @@ def main() -> None:
         _measure_cluster(result, enc_gbps)
     with _phase("qos"):
         _measure_qos(result)
+    with _phase("transport"):
+        _measure_transport(result, enc_gbps)
     rtt_end = _tunnel_rtt_ms()
     if rtt_end is not None:
         result["tunnel_rtt_end_ms"] = rtt_end

@@ -189,6 +189,16 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                              "high_recovery"],
                     help="osd_mclock_profile for the run (the "
                          "recovery-vs-client slosh knob)")
+    lg.add_argument("--transport", default=None,
+                    choices=["tcp", "shm_ring"],
+                    help="messenger lane (msgr_transport): shm_ring "
+                         "takes the shared-memory fast path for "
+                         "co-located peers, falling back to TCP per "
+                         "connection when the peer is out-of-process")
+    lg.add_argument("--op-shards", type=int, default=None,
+                    help="osd_op_num_shards: split each OSD's op "
+                         "worker into N per-PG-hash shards (default "
+                         "1 = the classic single worker)")
     return p.parse_args(argv)
 
 
@@ -423,6 +433,10 @@ def _run_loadgen(args) -> tuple[float, float]:
     overrides = dict(osd_op_coalescing=(args.coalesce == "on"))
     if getattr(args, "qos_profile", None):
         overrides["osd_mclock_profile"] = args.qos_profile
+    if getattr(args, "transport", None):
+        overrides["msgr_transport"] = args.transport
+    if getattr(args, "op_shards", None):
+        overrides["osd_op_num_shards"] = args.op_shards
     if args.lockdep:
         # arm the runtime lock-order / blocking-under-lock detector
         # for this cluster (locks read the flag at construction);

@@ -22,6 +22,7 @@ from .checksummer import (
     csum_value_size,
 )
 from .crc32c import crc32c as crc32c_host
+from .host import crc32c_wire
 from .crc32c import (
     crc32c_chain,
     crc32c_device,
@@ -41,6 +42,7 @@ __all__ = [
     "crc32c_scalar",
     "crc32c_seed_shift",
     "crc32c_stream",
+    "crc32c_wire",
     "csum_value_size",
     "xxh32_ref",
     "xxh64_ref",

@@ -27,4 +27,20 @@ def _select():
     return _ref.crc32c_ref
 
 
+def _select_wire():
+    # The wire-frame hot path: zero-copy bytes entry (no numpy
+    # round-trip per segment) when the native tier loads, the bitwise
+    # oracle otherwise. Bit-identical across backends — pinned by the
+    # cross-backend oracle in tests/test_wire_native.py.
+    try:
+        from ceph_tpu import native
+
+        if native.available():
+            return native.crc32c_bytes
+    except Exception:
+        pass
+    return _ref.crc32c_ref
+
+
 crc32c = _select()
+crc32c_wire = _select_wire()

@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from ceph_tpu.msg.messages import (
     BackfillReserve,
@@ -114,15 +114,19 @@ class _ClientOpItem:
     worker can recognize a RUN of coalescable writes and execute
     them as one tick batch."""
 
-    __slots__ = ("daemon", "conn", "msg")
+    __slots__ = ("daemon", "conn", "msg", "shard")
 
     def __init__(self, daemon: "OSDDaemon", conn, msg) -> None:
         self.daemon = daemon
         self.conn = conn
         self.msg = msg
+        #: op-shard this item was routed to at dispatch; execution
+        #: serializes under that shard's lock (shard 0 == the classic
+        #: single _op_lock path)
+        self.shard = 0
 
     def __call__(self) -> None:
-        self.daemon._run_client_op(self.conn, self.msg)
+        self.daemon._run_client_op(self.conn, self.msg, self.shard)
 
     def coalescable(self) -> bool:
         return self.msg.op in _COALESCE_OPS
@@ -674,7 +678,42 @@ class OSDDaemon:
         # op-serializing + structural locks, lockdep-tracked when the
         # `lockdep` config arms the detector (utils/lockdep.py; the
         # rank map documents the intended order: op -> pg -> stores)
-        self._op_lock = DebugLock("osd.op", rank=20, op_serializing=True)
+        # -- sharded op execution (osd_op_num_shards analog): ops
+        # route to a shard by (pool, pg) hash; each shard owns an
+        # op-serializing lock and — at nshards > 1 — its own worker
+        # thread and FIFO, so one EC write parked in a replicated
+        # drain cannot wedge other PGs' queue heads (the round-19
+        # flood-kill p99 head-of-line cliff). Shard 0's lock IS
+        # self._op_lock: at the default nshards=1 the daemon runs
+        # the classic single-worker path byte-for-byte (and tests
+        # that grab d._op_lock directly keep meaning what they did).
+        from ceph_tpu.utils import config as _shcfg
+
+        self._op_nshards = max(1, int(_shcfg.get("osd_op_num_shards")))
+        self._op_shards = [
+            DebugLock("osd.op", rank=20, op_serializing=True)
+            for _ in range(self._op_nshards)
+        ]
+        self._op_lock = self._op_shards[0]
+        #: per-shard FIFO + its wakeup (nshards > 1 only): the
+        #: dispatcher (the classic worker thread) drains the mClock
+        #: queue in tag order and appends here; shard workers run
+        #: their own queue in dispatch order
+        self._op_shard_queues = [deque() for _ in range(self._op_nshards)]
+        self._op_shard_cvs = [
+            threading.Condition() for _ in range(self._op_nshards)
+        ]
+        self._op_shard_workers: list[threading.Thread] = []
+        self._op_rr = 0  # round-robin cursor for unroutable thunks
+        #: leaf lock for the reqid-cache dicts' STRUCTURAL mutations
+        #: (new-key inserts, trims, clears, key-union iteration).
+        #: Under one worker these were _op_lock-serialized; shards
+        #: mutate them concurrently. Per-loc read-modify-write stays
+        #: safe without it (same loc -> same PG -> same shard lock);
+        #: existing-key setitems are GIL-atomic and stay bare. Rank
+        #: sits above op(20)/pg(30) and below the store tier (60+):
+        #: _req_window seeds from store.getattr while holding it.
+        self._reqcache_lock = DebugLock("osd.reqcache", rank=35)
         self._pg_lock = DebugLock("osd.pg", rank=30)
         self._pgmeta_lock = DebugLock("osd.pgmeta")  # serializes les updates
         #: mon config db entries this daemon has applied to the
@@ -780,11 +819,24 @@ class OSDDaemon:
             self._tick_thread.start()
         self._worker = threading.Thread(target=self._worker_loop, daemon=True)
         self._worker.start()
+        if self._op_nshards > 1:
+            for i in range(self._op_nshards):
+                t = threading.Thread(
+                    target=self._shard_loop, args=(i,),
+                    name=f"osd.{self.osd_id}-shard{i}", daemon=True,
+                )
+                t.start()
+                self._op_shard_workers.append(t)
         return self.addr
 
     def _worker_loop(self) -> None:
         """The op-queue worker (the OSD shard thread role): pulls
-        work in mClock tag order and runs it."""
+        work in mClock tag order and runs it. With osd_op_num_shards
+        > 1 this thread becomes the DISPATCHER: mClock tag order is
+        still honored here (dequeue() withholds work until its tag
+        time), but execution hands off to per-shard workers so one
+        op parked in a replicated drain no longer blocks the queue
+        head for every other PG."""
         import time as _time
 
         while not self._stopped:
@@ -798,6 +850,9 @@ class OSDDaemon:
                     self._sched_cv.wait(wait)
                     continue
             _cls, fn = got
+            if self._op_nshards > 1:
+                self._dispatch_to_shard(fn)
+                continue
             batch, leftover = self._collect_coalesce(fn)
             if batch is not None:
                 self._run_thunk(lambda: self._run_coalesced_batch(batch))
@@ -805,6 +860,96 @@ class OSDDaemon:
                 self._run_thunk(fn)
             if leftover is not None:
                 self._run_thunk(leftover)
+
+    # -- shard routing (nshards > 1) -----------------------------------
+    def _op_shard_index(self, pool: str, pgid: int) -> int:
+        """(pool, pg) -> shard. Stable across map epochs (the pg hash
+        moves only on pg-split), so every path that serializes against
+        a PG's client ops — scrub, catch-up push, backfill final pass,
+        peering rewind — lands on the same lock the dispatcher routes
+        that PG's ops to."""
+        import zlib as _zlib
+
+        return _zlib.crc32(f"{pool}.{pgid}".encode()) % self._op_nshards
+
+    def _op_lock_for(self, pool: str, pgid: int):
+        return self._op_shards[self._op_shard_index(pool, pgid)]
+
+    def _dispatch_to_shard(self, fn) -> None:
+        """Route one dequeued work item. Client ops hash by their
+        object's PG (same object -> same shard -> dispatch order
+        preserved); admit() grant thunks (ev.set) and other bare
+        callables run INLINE — they are instant, and running them on
+        the dispatcher keeps QoS grant timing exactly where the
+        scheduler decided it."""
+        if not isinstance(fn, _ClientOpItem):
+            self._run_thunk(fn)
+            return
+        msg = fn.msg
+        try:
+            pgid = (
+                int(msg.offset) if msg.op == "pgls"
+                else self.osdmap.object_to_pg(msg.pool, msg.oid)
+            )
+            idx = self._op_shard_index(msg.pool, pgid)
+        except Exception:
+            idx = 0  # unroutable (pool gone mid-flight): any shard
+        fn.shard = idx
+        cv = self._op_shard_cvs[idx]
+        with cv:
+            self._op_shard_queues[idx].append(fn)
+            cv.notify()
+
+    def _shard_loop(self, idx: int) -> None:
+        """One op shard's worker: drains its own FIFO in dispatch
+        order. Coalescable write runs collect from THIS shard's queue
+        only — batch-mates already share the shard lock the batch
+        executes under."""
+        q = self._op_shard_queues[idx]
+        cv = self._op_shard_cvs[idx]
+        while True:
+            with cv:
+                if not q:
+                    if self._stopped:
+                        return
+                    cv.wait(0.2)
+                    continue
+                fn = q.popleft()
+            batch = self._collect_shard_coalesce(idx, fn)
+            if batch is not None:
+                self._run_thunk(
+                    lambda: self._run_coalesced_batch(batch, idx)
+                )
+            else:
+                self._run_thunk(fn)
+
+    def _collect_shard_coalesce(self, idx: int, fn):
+        """Shard-local analog of _collect_coalesce: pull the RUN of
+        coalescable writes at the head of this shard's queue. No
+        leftover handling — a non-coalescable head item simply stays
+        queued in position."""
+        from ceph_tpu.utils import config as _cfg
+
+        if not (
+            isinstance(fn, _ClientOpItem)
+            and fn.coalescable()
+            and _cfg.get("osd_op_coalescing")
+        ):
+            return None
+        items = [fn]
+        cap = _cfg.get("osd_coalesce_max")
+        q, cv = self._op_shard_queues[idx], self._op_shard_cvs[idx]
+        with cv:
+            while (
+                len(items) < cap
+                and q
+                and isinstance(q[0], _ClientOpItem)
+                and q[0].coalescable()
+            ):
+                items.append(q.popleft())
+        if len(items) == 1:
+            return None
+        return items
 
     def _run_thunk(self, fn) -> None:
         try:
@@ -1070,8 +1215,13 @@ class OSDDaemon:
         self._stopped = True
         with self._sched_cv:
             self._sched_cv.notify_all()
+        for cv in self._op_shard_cvs:
+            with cv:
+                cv.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=2.0)
+        for t in self._op_shard_workers:
+            t.join(timeout=2.0)
         # backfill threads write to the store: they must land before a
         # caller closes it
         for t in list(self._backfills.values()):
@@ -1418,7 +1568,7 @@ class OSDDaemon:
             # from survivors read at T must not land at T+δ over an
             # extent a client write committed in between (the
             # lost-update shard tear the primary-victim soak caught)
-            push_lock = self._op_lock
+            push_lock = self._op_lock_for(pg.pool, pg.pgid)
             # Pristine member stamps, captured before any replay or
             # refresh can overwrite them (see _member_listing).
             member_listing = self._member_listing(pg, shard)
@@ -2170,7 +2320,9 @@ class OSDDaemon:
         )
         self._schedule(cls, _ClientOpItem(self, conn, msg), cost)
 
-    def _run_client_op(self, conn: Connection, msg: OSDOp) -> None:
+    def _run_client_op(
+        self, conn: Connection, msg: OSDOp, shard: int = 0
+    ) -> None:
         try:
             # adopt the client's trace context (the wire hop of the
             # ZTracer-through-the-pipeline pattern): this daemon's
@@ -2181,7 +2333,7 @@ class OSDDaemon:
                     "osd_op", op=msg.op, oid=msg.oid,
                     osd=self.osd_id, tid=msg.tid,
                 ):
-                    reply = self._execute_client_op(msg, conn)
+                    reply = self._execute_client_op(msg, conn, shard)
         except Exception as e:  # never kill the worker
             self.log.error(
                 "client op", msg.op, f"{msg.pool}/{msg.oid}",
@@ -2204,7 +2356,8 @@ class OSDDaemon:
         conn.send(reply)
 
     def _execute_client_op(
-        self, msg: OSDOp, conn: "Connection | None" = None
+        self, msg: OSDOp, conn: "Connection | None" = None,
+        shard: int = 0,
     ) -> OSDOpReply:
         epoch = self.osdmap.epoch
         spec = self.osdmap.pools.get(msg.pool)
@@ -2239,7 +2392,7 @@ class OSDDaemon:
             return self._op_unwatch(msg)
         if msg.op == "notify":
             return self._op_notify(msg, client_oid)
-        with self._op_lock:
+        with self._op_shards[shard]:
             self._drain_req_flushes()
             reply, pg = self._mutating_gate(msg, spec, pgid, epoch)
             if reply is not None:
@@ -2318,7 +2471,9 @@ class OSDDaemon:
     # Per-op error isolation: one op's failure (inject, codec fault,
     # degraded read) replies eio for THAT op; batch-mates commit.
 
-    def _run_coalesced_batch(self, items: "list[_ClientOpItem]") -> None:
+    def _run_coalesced_batch(
+        self, items: "list[_ClientOpItem]", shard: int = 0
+    ) -> None:
         to_send: list[tuple] = []
         pre: list[_CoalCtx] = []
         for it in items:
@@ -2351,7 +2506,7 @@ class OSDDaemon:
                     data=str(e).encode())))
         executed = 0
         if pre:
-            with self._op_lock:
+            with self._op_shards[shard]:
                 self._drain_req_flushes()
                 pending = pre
                 while pending:
@@ -2716,9 +2871,13 @@ class OSDDaemon:
         if reply.error == "eagain":
             return reply
         if msg.reqid:
-            self._completed_ops[msg.reqid] = reply
-            while len(self._completed_ops) > self._completed_cap:
-                self._completed_ops.popitem(last=False)
+            # insert + trim under the reqcache leaf: shards record
+            # concurrently, and an interleaved popitem while another
+            # shard trims must not double-evict past the cap
+            with self._reqcache_lock:
+                self._completed_ops[msg.reqid] = reply
+                while len(self._completed_ops) > self._completed_cap:
+                    self._completed_ops.popitem(last=False)
         return reply
 
     def _drain_req_flushes(self) -> None:
@@ -2730,6 +2889,14 @@ class OSDDaemon:
             if not self._req_flush:
                 return
             pending, self._req_flush = self._req_flush, set()
+        # the apply phase iterates a key-union of the reqid dicts:
+        # another shard's _req_window seeding a NEW loc mid-union
+        # would blow up the iteration — structural phase takes the
+        # reqcache leaf (rank 35; _req_poll_lock nests under it)
+        with self._reqcache_lock:
+            self._apply_req_flushes(pending)
+
+    def _apply_req_flushes(self, pending: set) -> None:
         if None in pending:
             self._req_windows.clear()
             self._req_unverified.clear()
@@ -2786,16 +2953,22 @@ class OSDDaemon:
                     win = parse_reqs(self.store.getattr(key, REQ_KEY))
                 except (FileNotFoundError, KeyError, ValueError):
                     pass
-            if win:
-                # storage-seeded entries are suspect until a quorum
-                # poll proves them durable (see _verify_req_durable)
-                self._req_unverified[loc] = {t[0] for t in win}
-            if len(self._req_windows) > 4096:
-                old = next(iter(self._req_windows))
-                self._req_windows.pop(old)
-                self._req_unverified.pop(old, None)
-                self._req_poll_at.pop(old, None)
-            self._req_windows[loc] = win
+            # structural inserts + trim under the reqcache leaf: the
+            # trim's next(iter(...)) and a sibling shard's new-key
+            # insert must not interleave. No double-seed race to
+            # resolve — same loc always lands on the same shard.
+            with self._reqcache_lock:
+                if win:
+                    # storage-seeded entries are suspect until a
+                    # quorum poll proves them durable (see
+                    # _verify_req_durable)
+                    self._req_unverified[loc] = {t[0] for t in win}
+                if len(self._req_windows) > 4096:
+                    old = next(iter(self._req_windows))
+                    self._req_windows.pop(old)
+                    self._req_unverified.pop(old, None)
+                    self._req_poll_at.pop(old, None)
+                self._req_windows[loc] = win
         return win
 
     #: deadline for the one-shot durability fan-out (rare failover
@@ -2836,7 +3009,8 @@ class OSDDaemon:
             return None
         if not self._req_poll_sem.acquire(blocking=False):
             return None  # budget exhausted: eagain, retry into a slot
-        self._req_poll_at[loc] = now
+        with self._reqcache_lock:  # possibly a new key: structural
+            self._req_poll_at[loc] = now
         with self._req_poll_lock:
             self._req_polls_inflight.add(loc)
 
@@ -4187,9 +4361,10 @@ class OSDDaemon:
         pg = self._get_pg(pool, pgid)
         locs = sorted(self._backfill_scan(pool, pgid, spec, pg))
         results = []
+        op_lock = self._op_lock_for(pool, pgid)
         for loc in locs:
             self.admit("scrub")
-            with self._op_lock:
+            with op_lock:
                 if not self._object_size(pg, loc) and not (
                     self._have_object(pg, loc)
                 ):
@@ -4299,7 +4474,7 @@ class OSDDaemon:
             # final pass: writes that landed mid-backfill, under the
             # op lock so nothing new sneaks in; then drop pg_temp
             top.mark_event("final_pass")
-            with self._op_lock:
+            with self._op_lock_for(pool, pgid):
                 while True:
                     with self._pg_lock:
                         dirty = set(pg.backfill_dirty)
@@ -4500,7 +4675,7 @@ class OSDDaemon:
             # serialize with client ops: a scrub racing a mid-commit
             # write would see mixed-epoch shards and (with repair)
             # write the mixture back
-            with self._op_lock:
+            with self._op_lock_for(pool, pgid):
                 results.append(self._scrub_object(pg, loc, repair))
         return results
 

@@ -780,7 +780,9 @@ class PgPeeringFsm:
                         or pg.pglog.dirty_xattrs(shard)
                     )
 
-                with d._op_lock:
+                # the shard lock this PG's client ops serialize
+                # under (== d._op_lock at osd_op_num_shards=1)
+                with d._op_lock_for(pg.pool, pg.pgid):
                     if _dirty():
                         pg.recovery.recover_from_log(pg.pglog, shard)
                     if not _dirty():

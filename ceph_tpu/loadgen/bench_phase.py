@@ -398,3 +398,217 @@ def measure_qos(result: dict) -> None:
         a = rep.get("tenants", {}).get("tenantA", {})
         if a.get("lat_p99_ms") is not None:
             result[f"qos_slosh_{prof}_p99_ms"] = a["lat_p99_ms"]
+
+
+# -- the round-20 transport phase: shm-ring lane + native codec ---------
+def transport_leg(
+    total_ops: int,
+    qd: int,
+    max_objects: int,
+    *,
+    transport: str = "tcp",
+    native_codec: bool = True,
+    op_shards: int = 1,
+    faults: bool = False,
+    seed: int = 0xEC20,
+) -> dict:
+    """One transport A/B leg: the standard mixed workload with the
+    messenger lane (tcp | shm_ring), the clear-frame codec
+    (native C | pure Python) and the op-shard count pinned by
+    config for the whole cluster lifetime. The shm stats registry
+    is reset per leg so chunks/bytes are leg-scoped."""
+    from ceph_tpu.msg import shm_ring
+    from ceph_tpu.utils import config as _cfg
+
+    shm_ring.reset_stats()
+    with _cfg.override(
+        msgr_transport=transport,
+        msgr_native_codec=native_codec,
+        osd_op_num_shards=op_shards,
+    ):
+        cluster = LoadCluster(
+            n_osds=6, k=4, m=2, pg_num=8, chunk_size=16384,
+        )
+        try:
+            spec = WorkloadSpec(
+                mix=dict(_MIX),
+                object_size=256 * 1024,
+                max_objects=max_objects,
+                queue_depth=qd,
+                total_ops=total_ops,
+                warmup_ops=max(total_ops // 10, 8),
+                popularity="zipfian",
+                seed=seed,
+            )
+            schedule = None
+            if faults:
+                schedule = FaultSchedule(
+                    [
+                        FaultEvent(at_op=total_ops // 3, action="kill"),
+                        FaultEvent(at_op=(2 * total_ops) // 3,
+                                   action="revive"),
+                    ]
+                )
+            report = run_spec(cluster, spec, schedule)
+            report["shm"] = shm_ring.snapshot()
+            return report
+        finally:
+            cluster.shutdown()
+
+
+def hol_probe_ms(nshards: int, park_s: float = 0.75) -> float:
+    """Deterministic head-of-line probe: park one op shard's lock on
+    a primary for ``park_s`` (the stand-in for the EC write wedged in
+    its sub-write ``drain_until`` ladder) and time a write to a
+    DIFFERENT PG on the SAME primary. At one shard the sibling rides
+    the park (~park_s); with a shard pool it lands in milliseconds.
+    Unlike the flood x kill legs this exercises the wedge on every
+    run — the ``on_shard_down`` race the real cliff needs is
+    nondeterministic."""
+    import time as _time
+
+    from ceph_tpu.utils import config as _cfg
+
+    with _cfg.override(osd_op_num_shards=nshards):
+        cluster = LoadCluster(
+            n_osds=5, k=2, m=1, pg_num=8, chunk_size=4096,
+        )
+        try:
+            mon, pool = cluster.mon, cluster.pool
+            pick = None
+            by_primary: dict = {}
+            for i in range(200):
+                oid = f"holp-{i}"
+                pgid = mon.osdmap.object_to_pg(pool, oid)
+                primary = mon.osdmap.pg_primary(pool, pgid)
+                d = cluster.daemons[primary]
+                shard = d._op_shard_index(pool, pgid)
+                slots = by_primary.setdefault(primary, {})
+                # one shard: any two distinct PGs share slot key 0,
+                # so key by pgid instead to get two distinct queues
+                key = shard if nshards > 1 else pgid
+                slots.setdefault(key, (oid, shard))
+                if len(slots) >= 2:
+                    (oid_a, shard_a), (oid_b, _sb) = list(
+                        slots.values()
+                    )[:2]
+                    pick = (d, oid_a, shard_a, oid_b)
+                    break
+            if pick is None:
+                return -1.0
+            d, oid_a, shard_a, oid_b = pick
+            payload = b"\x5a" * 8192
+            cluster.io.write_full(oid_a, payload)  # peer + seed windows
+            cluster.io.write_full(oid_b, payload)
+            lock_a = d._op_shards[shard_a]
+            with lock_a:
+                t0 = _time.monotonic()
+                comp = cluster.io.aio_write_full(oid_b, payload)
+                try:
+                    comp.wait_for_complete(park_s)
+                except TimeoutError:
+                    pass  # the 1-shard arm rides the park by design
+            try:
+                comp.wait_for_complete(10.0)
+            except TimeoutError:
+                return -1.0
+            elapsed = _time.monotonic() - t0
+            return round(elapsed * 1e3, 3) if comp.is_complete() else -1.0
+        finally:
+            cluster.shutdown()
+
+
+def measure_transport(result: dict, enc_gbps: float) -> None:
+    """The ISSUE-20 within-run A/B grid (transport x codec), the
+    shm-lane headline, and the flood-kill shard ladder:
+
+    - ``transport_{tcp,shm}_{py,native}_gbps`` four-leg grid plus a
+      per-leg ``cluster_vs_kernel_frac`` row
+      (``transport_<leg>_vs_kernel_frac``) — same workload, same
+      seed, one process, so the ratios are tunnel-drift-free;
+    - ``frame_codec_speedup``  tcp+native over tcp+python — what
+      moving frame assembly/verify into C buys the wire path;
+    - ``shm_ring_gbps`` / ``shm_ring_speedup``  the co-located lane
+      over loopback TCP (both on the native codec);
+    - ``shm_ring_chunks`` / ``shm_ring_bytes``  lane traffic proof
+      (zero chunks means the negotiation never upgraded — a red
+      flag, not a fast run);
+    - ``transport_shards{1,4}_p{50,95,99}_ms`` /
+      ``shard_hol_p95_frac``  flood x kill tenant-A latency spread
+      at 1 vs 4 op shards — the head-of-line regression row. The
+      parked EC write itself still drains its ~15 s ``drain_until``
+      ladder at ANY shard count (that is the sub-write retransmit
+      path, not the worker), so the max/p99 can cliff either way;
+      what the shard pool removes is the COLLATERAL wedge — every
+      other PG's queue head stuck behind the parked op — which is
+      exactly the p50/p95 spread (BASELINE row 64's caveat).
+
+    Sized by CEPH_TPU_BENCH_TRANSPORT_OPS / _QD (defaults 160/24)."""
+    total_ops = int(
+        os.environ.get("CEPH_TPU_BENCH_TRANSPORT_OPS", "160")
+    )
+    qd = int(os.environ.get("CEPH_TPU_BENCH_TRANSPORT_QD", "24"))
+    max_objects = 128
+
+    legs = {}
+    for tag, transport, native in (
+        ("tcp_py", "tcp", False),
+        ("tcp_native", "tcp", True),
+        ("shm_py", "shm_ring", False),
+        ("shm_native", "shm_ring", True),
+    ):
+        rep = transport_leg(
+            total_ops, qd, max_objects,
+            transport=transport, native_codec=native,
+        )
+        legs[tag] = rep
+        result[f"transport_{tag}_gbps"] = rep["gbps"]
+        result[f"transport_{tag}_iops"] = rep["iops"]
+        if enc_gbps:
+            result[f"transport_{tag}_vs_kernel_frac"] = round(
+                rep["gbps"] / enc_gbps, 8
+            )
+    if legs["tcp_py"]["gbps"]:
+        result["frame_codec_speedup"] = round(
+            legs["tcp_native"]["gbps"] / legs["tcp_py"]["gbps"], 4
+        )
+    result["shm_ring_gbps"] = legs["shm_native"]["gbps"]
+    if legs["tcp_native"]["gbps"]:
+        result["shm_ring_speedup"] = round(
+            legs["shm_native"]["gbps"] / legs["tcp_native"]["gbps"], 4
+        )
+    result["shm_ring_chunks"] = legs["shm_native"]["shm"]["chunks"]
+    result["shm_ring_bytes"] = legs["shm_native"]["shm"]["bytes"]
+
+    # -- flood x kill shard ladder: the head-of-line row. Same storm
+    # (tenant flood + mid-run kill/revive, qos_leg's schedule shape)
+    # at 1 shard vs 4; the collateral wedge shows in the tenant-A
+    # latency SPREAD (p50/p95), not the single parked op's own p99.
+    from ceph_tpu.utils import config as _cfg
+
+    for n in (1, 4):
+        with _cfg.override(osd_op_num_shards=n):
+            rep = qos_leg(
+                total_ops, qd, max_objects=64, flood=True,
+                faults=True, seed=0xEC20,
+            )
+        a = rep.get("tenants", {}).get("tenantA", {})
+        for pct in ("p50", "p95", "p99"):
+            v = a.get(f"lat_{pct}_ms")
+            if v is not None:
+                result[f"transport_shards{n}_{pct}_ms"] = v
+    p1 = result.get("transport_shards1_p95_ms")
+    pn = result.get("transport_shards4_p95_ms")
+    if p1 and pn:
+        # < 1.0 means the shard pool cut the storm's latency spread
+        result["shard_hol_p95_frac"] = round(pn / p1, 4)
+
+    # -- the deterministic wedge probe (parked shard, timed sibling)
+    h1 = hol_probe_ms(1)
+    h4 = hol_probe_ms(4)
+    if h1 > 0:
+        result["shard_hol_probe_shards1_ms"] = h1
+    if h4 > 0:
+        result["shard_hol_probe_shards4_ms"] = h4
+    if h1 > 0 and h4 > 0:
+        result["shard_hol_probe_frac"] = round(h4 / h1, 4)

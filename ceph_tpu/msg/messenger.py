@@ -38,6 +38,7 @@ import zlib
 from collections.abc import Callable
 
 from . import secure as secure_mod
+from . import shm_ring
 from .messages import decode_message, message_type
 from .wire import BadFrame, decode_frame, encode_frame
 from ceph_tpu.utils import lockdep
@@ -631,6 +632,9 @@ class Messenger:
         self.addr = s.getsockname()
         with _addr_lock:
             _addr_names[self.addr] = self.name
+        # shm-ring lane registration (always cheap; the msgr_transport
+        # gate decides at connect() time whether anyone upgrades)
+        shm_ring.register(self.addr, self)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -671,6 +675,26 @@ class Messenger:
 
     # -- client side ---------------------------------------------------
     def connect(self, addr: tuple[str, int]) -> Connection:
+        # Transport negotiation: when the shm-ring lane is configured
+        # and the peer listens in-process, skip the kernel socket
+        # entirely — the Connection (framing, CRC, secure handshake,
+        # fault-plane hooks) runs unchanged over the ring pair.
+        target = shm_ring.lookup(addr)
+        if target is not None:
+            client_sock, server_sock = shm_ring.socketpair()
+            # the server end rides the normal accept path, off-thread
+            # (the secure handshake blocks, exactly like TCP accepts)
+            threading.Thread(
+                target=target._finish_accept,
+                args=(server_sock,),
+                daemon=True,
+            ).start()
+            conn = Connection(
+                client_sock, self, is_client=True, peer_name=target.name
+            )
+            with self._lock:
+                self._conns.add(conn)
+            return conn
         sock = socket.create_connection(addr, timeout=10)
         if sock.getsockname() == sock.getpeername():
             # TCP self-connect: the kernel picked the (freed) target
@@ -693,6 +717,7 @@ class Messenger:
     def shutdown(self) -> None:
         self._stopping = True
         if self.addr is not None:
+            shm_ring.unregister(self.addr, self)
             with _addr_lock:
                 if _addr_names.get(self.addr) == self.name:
                     del _addr_names[self.addr]

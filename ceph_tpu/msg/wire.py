@@ -23,6 +23,12 @@ drops crc protection in favor of the auth tag). Layout:
 Compression composes: segments deflate first, then the whole frame
 body seals. Tampering with header or body raises ``BadFrame`` via the
 AEAD check; replayed frames are rejected by the session counter.
+
+Clear-mode (CRC) frames have a native fast path: header + segment
+table + per-segment crc32c assemble/verify in one C call each
+(native/src/ceph_tpu_native.cc frame codec), gated on
+``msgr_native_codec`` and ``CEPH_TPU_NO_NATIVE``, bit-identical to
+the pure-Python path kept below as the fallback and oracle.
 """
 
 from __future__ import annotations
@@ -30,7 +36,8 @@ from __future__ import annotations
 import struct
 import zlib
 
-from ceph_tpu.checksum import crc32c_scalar as _crc32c_host
+from ceph_tpu.checksum import crc32c_wire as _crc32c_host
+from ceph_tpu.utils.config import config as _config
 
 MAGIC = b"CTv2"
 _HDR = struct.Struct("<4sHBBQ")  # magic, type, flags, nseg, seq
@@ -52,6 +59,39 @@ class BadFrame(Exception):
 
 def _crc(data: bytes) -> int:
     return _crc32c_host(CRC_SEED, data)
+
+
+# Native frame codec (ceph_tpu_native.cc frame_encode/frame_verify):
+# the clear-mode header+table+CRC assembly runs as one C call instead
+# of per-segment struct.pack / bytes churn. The module probe is cached;
+# the config gate (msgr_native_codec) is read per frame so bench A/B
+# legs can flip it with config.override. CEPH_TPU_NO_NATIVE disables
+# the probe entirely; the pure-Python path below stays bit-identical
+# (pinned by tests/test_wire_native.py).
+_native_mod = None
+_native_probed = False
+
+
+def _native():
+    global _native_mod, _native_probed
+    if not _native_probed:
+        _native_probed = True
+        try:
+            from ceph_tpu import native as _n
+
+            if _n.available():
+                _native_mod = _n
+        except Exception:
+            _native_mod = None
+    return _native_mod
+
+
+def _codec():
+    """The native codec module when loaded AND enabled, else None."""
+    mod = _native()
+    if mod is None:
+        return None
+    return mod if _config.get("msgr_native_codec") else None
 
 
 def encode_frame(
@@ -79,6 +119,9 @@ def encode_frame(
             body += seg
         counter, ct = secure.seal(hdr, bytes(body))
         return hdr + _SECHDR.pack(counter, len(ct)) + ct
+    codec = _codec()
+    if codec is not None:
+        return codec.frame_encode(msg_type, flags, seq, segments)
     out = bytearray(_HDR.pack(MAGIC, msg_type, flags, len(segments), seq))
     for seg in segments:
         out += _SEG.pack(len(seg), _crc(seg))
@@ -136,16 +179,35 @@ def decode_frame(read_exact, secure=None) -> tuple[int, int, list[bytes]]:
                     raise BadFrame(f"segment inflate failed: {e}") from e
             segments.append(seg)
         return msg_type, seq, segments
+    # Clear mode: one read for the whole segment table, one for the
+    # concatenated payloads (fewer recv round-trips than the old
+    # entry-at-a-time loop), then a single native batch CRC verify
+    # when the codec is armed — per-segment Python CRC otherwise.
+    table_raw = read_exact(nseg * _SEG.size)
     table = []
-    for _ in range(nseg):
-        length, crc = _SEG.unpack(read_exact(_SEG.size))
+    total = 0
+    for length, crc in _SEG.iter_unpack(table_raw):
         if length > MAX_SEGMENT_BYTES:
             raise BadFrame(f"segment too large: {length}")
         table.append((length, crc))
+        total += length
+    payload = read_exact(total)
+    codec = _codec()
+    if codec is not None:
+        bad = codec.frame_verify(table_raw, payload)
+        if bad == -2:
+            raise BadFrame("segment table/payload length mismatch")
+        if bad >= 0:
+            raise BadFrame(
+                f"segment crc mismatch: segment {bad}"
+                f" want {table[bad][1]:#x}"
+            )
     segments = []
+    pos = 0
     for length, crc in table:
-        seg = read_exact(length)
-        if _crc(seg) != crc:
+        seg = payload[pos : pos + length]
+        pos += length
+        if codec is None and _crc(seg) != crc:
             raise BadFrame(
                 f"segment crc mismatch: got {_crc(seg):#x} want {crc:#x}"
             )

@@ -83,10 +83,35 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ctpu_ring_pop.argtypes = [
         ctypes.c_void_p, u8p, ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
     ]
+    lib.ctpu_ring_push_timed.restype = ctypes.c_int
+    lib.ctpu_ring_push_timed.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_uint32, ctypes.c_int32,
+    ]
+    lib.ctpu_ring_pop_timed.restype = ctypes.c_int
+    lib.ctpu_ring_pop_timed.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int32,
+    ]
     lib.ctpu_ring_count.restype = ctypes.c_uint32
     lib.ctpu_ring_count.argtypes = [ctypes.c_void_p]
     lib.ctpu_ring_total_pushed.restype = ctypes.c_uint64
     lib.ctpu_ring_total_pushed.argtypes = [ctypes.c_void_p]
+    # frame codec (msg/wire.py clear-mode hot path). c_char_p args are
+    # zero-copy for Python bytes — no numpy round-trip per frame.
+    lib.ctpu_crc32c_buf.restype = ctypes.c_uint32
+    lib.ctpu_crc32c_buf.argtypes = [
+        ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.ctpu_frame_encode.restype = ctypes.c_size_t
+    lib.ctpu_frame_encode.argtypes = [
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+        u8p,
+    ]
+    lib.ctpu_frame_verify.restype = ctypes.c_int
+    lib.ctpu_frame_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint64,
+    ]
 
 
 def _load() -> ctypes.CDLL | None:
@@ -131,6 +156,59 @@ def crc32c(init: int, data) -> int:
     buf = np.frombuffer(bytes(data), dtype=np.uint8) \
         if not isinstance(data, np.ndarray) else np.ascontiguousarray(data)
     return lib.ctpu_crc32c(init & 0xFFFFFFFF, _as_u8p(buf), buf.size)
+
+
+def crc32c_bytes(init: int, data) -> int:
+    """Native crc32c over a bytes-like object, zero-copy for ``bytes``
+    (no numpy round-trip — the wire hot-path entry). Semantics match
+    :func:`crc32c` exactly: raw register in/out, no final xor."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    if not isinstance(data, bytes):
+        data = bytes(data)
+    return lib.ctpu_crc32c_buf(init & 0xFFFFFFFF, data, len(data))
+
+
+# -- frame codec ---------------------------------------------------------
+def frame_encode(msg_type: int, flags: int, seq: int, segments) -> bytes:
+    """Assemble a clear-mode wire frame (header + segment table with
+    per-segment crc32c + payloads) in one native call. ``segments`` is
+    a sequence of bytes-like objects; compressed segments arrive
+    pre-deflated. Bit-identical to the pure-Python wire.encode_frame
+    clear path (pinned by tests/test_wire_native.py)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    segs = [s if isinstance(s, bytes) else bytes(s) for s in segments]
+    nseg = len(segs)
+    total = 16 + nseg * 8 + sum(len(s) for s in segs)
+    out = bytearray(total)
+    ptrs = (ctypes.c_char_p * nseg)(*segs)
+    lens = (ctypes.c_uint64 * nseg)(*[len(s) for s in segs])
+    written = lib.ctpu_frame_encode(
+        msg_type, flags, seq, nseg, ptrs, lens,
+        (ctypes.c_uint8 * total).from_buffer(out),
+    )
+    if written != total:
+        raise RuntimeError(
+            f"frame encode size mismatch: {written} != {total}"
+        )
+    return bytes(out)
+
+
+def frame_verify(table, payload) -> int:
+    """Batch-verify per-segment CRCs of a received clear frame. Returns
+    -1 when all segments match, -2 on a length/table mismatch, else the
+    index of the first bad segment."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    if not isinstance(table, bytes):
+        table = bytes(table)
+    if not isinstance(payload, bytes):
+        payload = bytes(payload)
+    return lib.ctpu_frame_verify(table, len(table) // 8, payload, len(payload))
 
 
 # -- GF region ops -------------------------------------------------------
@@ -212,6 +290,40 @@ class RingBuffer:
         if rc != 1:
             return None
         return out[: ln.value].tobytes()
+
+    def push_timed(self, data, timeout: "float | None" = None) -> int:
+        """Push with a bounded wait: 1 = pushed, 0 = ring closed,
+        -2 = timed out (timeout is seconds; None waits forever)."""
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        # zero-copy view of the bytes object (c_char_p cast, no staging
+        # copy — the C side memcpys straight into the slot)
+        ptr = ctypes.cast(
+            ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8)
+        )
+        rc = self._lib.ctpu_ring_push_timed(self._ring, ptr, len(data), ms)
+        if rc == -1:
+            raise ValueError(
+                f"slot overflow: {len(data)} > {self.slot_bytes}"
+            )
+        return rc
+
+    def pop_timed(self, timeout: "float | None" = None):
+        """Pop with a bounded wait: (1, chunk) on success, (0, None)
+        when the ring is closed and drained, (-2, None) on timeout."""
+        ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        out = bytearray(self.slot_bytes)
+        ln = ctypes.c_uint32()
+        rc = self._lib.ctpu_ring_pop_timed(
+            self._ring,
+            (ctypes.c_uint8 * self.slot_bytes).from_buffer(out),
+            ctypes.byref(ln),
+            ms,
+        )
+        if rc != 1:
+            return rc, None
+        return 1, bytes(out[: ln.value])
 
     def close(self) -> None:
         self._lib.ctpu_ring_close(self._ring)

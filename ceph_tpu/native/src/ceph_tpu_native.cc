@@ -15,6 +15,7 @@
 //
 // Plain C ABI so ctypes loads it with no binding generator.
 
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <condition_variable>
@@ -239,6 +240,55 @@ int ctpu_ring_pop(void* h, uint8_t* out, uint32_t* len, int blocking) {
     return 1;
 }
 
+// Timed variants for transport use (msg/shm_ring.py): wait up to
+// timeout_ms (negative = forever). Returns 1 on success, 0 when the
+// ring is closed (push) / closed and drained (pop), -2 on timeout,
+// -1 on bad args. A closed ring still drains buffered slots — the
+// byte-stream EOF contract a half-closed TCP socket provides.
+int ctpu_ring_push_timed(void* h, const uint8_t* data, uint32_t len,
+                         int32_t timeout_ms) {
+    Ring* r = static_cast<Ring*>(h);
+    if (!r || len > r->slot_bytes) return -1;
+    std::unique_lock<std::mutex> lk(r->mu);
+    auto ready = [r] { return r->count < r->capacity || r->closed; };
+    if (timeout_ms < 0) {
+        r->not_full.wait(lk, ready);
+    } else if (!r->not_full.wait_for(
+                   lk, std::chrono::milliseconds(timeout_ms), ready)) {
+        return -2;
+    }
+    if (r->closed) return 0;
+    std::memcpy(r->slots + size_t(r->tail) * r->slot_bytes, data, len);
+    r->lens[r->tail] = len;
+    r->tail = (r->tail + 1) % r->capacity;
+    r->count++;
+    r->total_pushed++;
+    r->not_empty.notify_one();
+    return 1;
+}
+
+int ctpu_ring_pop_timed(void* h, uint8_t* out, uint32_t* len,
+                        int32_t timeout_ms) {
+    Ring* r = static_cast<Ring*>(h);
+    if (!r || !out || !len) return -1;
+    std::unique_lock<std::mutex> lk(r->mu);
+    auto ready = [r] { return r->count > 0 || r->closed; };
+    if (timeout_ms < 0) {
+        r->not_empty.wait(lk, ready);
+    } else if (!r->not_empty.wait_for(
+                   lk, std::chrono::milliseconds(timeout_ms), ready)) {
+        return -2;
+    }
+    if (r->count == 0) return 0;
+    std::memcpy(out, r->slots + size_t(r->head) * r->slot_bytes,
+                r->lens[r->head]);
+    *len = r->lens[r->head];
+    r->head = (r->head + 1) % r->capacity;
+    r->count--;
+    r->not_full.notify_one();
+    return 1;
+}
+
 uint32_t ctpu_ring_count(void* h) {
     Ring* r = static_cast<Ring*>(h);
     std::lock_guard<std::mutex> lk(r->mu);
@@ -249,6 +299,74 @@ uint64_t ctpu_ring_total_pushed(void* h) {
     Ring* r = static_cast<Ring*>(h);
     std::lock_guard<std::mutex> lk(r->mu);
     return r->total_pushed;
+}
+
+// ----------------------------------------------------------- frame codec
+// msg/wire.py hot-path analog (the reference's msgr2 frame assembly,
+// src/msg/async/frames_v2.cc): clear-mode frames only — a 16-byte
+// little-endian header (magic "CTv2", u16 msg_type, u8 flags, u8 nseg,
+// u64 seq), an nseg x (u32 len, u32 crc32c) segment table, then the
+// concatenated payloads. CRCs are seeded 0xFFFFFFFF per segment
+// (wire.CRC_SEED), matching the Python path bit-for-bit. Compressed
+// segments arrive pre-deflated (the zlib step stays in Python); secure
+// frames never reach this path.
+
+// zero-copy crc32c entry for Python bytes (no numpy round-trip).
+uint32_t ctpu_crc32c_buf(uint32_t crc, const char* data, size_t len) {
+    return ctpu_crc32c(crc, reinterpret_cast<const uint8_t*>(data), len);
+}
+
+// Assemble header + table + payloads into `out` (caller sizes it as
+// 16 + nseg*8 + sum(lens)). Returns total bytes written.
+size_t ctpu_frame_encode(uint32_t msg_type, uint32_t flags, uint64_t seq,
+                         uint32_t nseg, const char* const* segs,
+                         const uint64_t* lens, uint8_t* out) {
+    uint8_t* p = out;
+    p[0] = 'C'; p[1] = 'T'; p[2] = 'v'; p[3] = '2';
+    p[4] = msg_type & 0xFF; p[5] = (msg_type >> 8) & 0xFF;
+    p[6] = flags & 0xFF;
+    p[7] = nseg & 0xFF;
+    for (int b = 0; b < 8; b++) p[8 + b] = (seq >> (8 * b)) & 0xFF;
+    p += 16;
+    uint8_t* table = p;
+    p += size_t(nseg) * 8;
+    for (uint32_t i = 0; i < nseg; i++) {
+        const uint8_t* seg = reinterpret_cast<const uint8_t*>(segs[i]);
+        uint64_t len = lens[i];
+        uint32_t crc = ctpu_crc32c(0xFFFFFFFFu, seg, len);
+        for (int b = 0; b < 4; b++)
+            table[i * 8 + b] = (len >> (8 * b)) & 0xFF;
+        for (int b = 0; b < 4; b++)
+            table[i * 8 + 4 + b] = (crc >> (8 * b)) & 0xFF;
+        std::memcpy(p, seg, len);
+        p += len;
+    }
+    return static_cast<size_t>(p - out);
+}
+
+// Batch-verify the per-segment CRCs of a received clear frame:
+// `table` is the raw nseg*8-byte little-endian (len, crc) entries,
+// `payload` the concatenated segment bytes. Returns -1 when every
+// segment matches, -2 when the table lengths disagree with
+// payload_len, else the index of the first mismatching segment.
+int ctpu_frame_verify(const char* table_c, uint32_t nseg,
+                      const char* payload_c, uint64_t payload_len) {
+    const uint8_t* table = reinterpret_cast<const uint8_t*>(table_c);
+    const uint8_t* payload = reinterpret_cast<const uint8_t*>(payload_c);
+    uint64_t off = 0;
+    for (uint32_t i = 0; i < nseg; i++) {
+        uint32_t len = 0, want = 0;
+        for (int b = 0; b < 4; b++)
+            len |= static_cast<uint32_t>(table[i * 8 + b]) << (8 * b);
+        for (int b = 0; b < 4; b++)
+            want |= static_cast<uint32_t>(table[i * 8 + 4 + b]) << (8 * b);
+        if (off + len > payload_len) return -2;
+        uint32_t got = ctpu_crc32c(0xFFFFFFFFu, payload + off, len);
+        if (got != want) return static_cast<int>(i);
+        off += len;
+    }
+    if (off != payload_len) return -2;
+    return -1;
 }
 
 }  // extern "C"

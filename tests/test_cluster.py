@@ -313,6 +313,7 @@ def _bare_daemon():
     d._req_poll_lock = threading.Lock()
     d._req_flush = set()
     d._req_flush_lock = threading.Lock()
+    d._reqcache_lock = threading.Lock()
     return d
 
 

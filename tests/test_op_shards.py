@@ -1,0 +1,257 @@
+"""Sharded op execution (ISSUE 20 tentpole (c)): osd_op_num_shards
+splits the OSD's op worker into per-(pool,pg)-hash shards so one op
+parked in a replicated drain — the flood-kill p99 head-of-line wedge
+ROADMAP #3 documented — cannot block other PGs' queue heads. The
+default (1 shard) must stay the classic single-worker path; shards
+must preserve per-object ordering and reqid dedup.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.loadgen.cluster import LoadCluster
+from ceph_tpu.utils.config import config
+
+
+def _boot(nshards, **kw):
+    kw.setdefault("n_osds", 5)
+    kw.setdefault("k", 2)
+    kw.setdefault("m", 1)
+    kw.setdefault("pg_num", 8)
+    kw.setdefault("chunk_size", 512)
+    return LoadCluster(**kw)
+
+
+# ---------------------------------------------------------------------------
+# default: the legacy single-worker daemon, byte-compatible
+# ---------------------------------------------------------------------------
+class TestDefaultSingleShard:
+    def test_one_shard_no_extra_workers(self):
+        cluster = _boot(1)
+        try:
+            d = cluster.daemons[0]
+            assert d._op_nshards == 1
+            assert d._op_shards == [d._op_lock]
+            assert d._op_shard_workers == []
+            cluster.io.write_full("obj", b"x" * 900)
+            assert cluster.io.read("obj") == b"x" * 900
+        finally:
+            cluster.shutdown()
+
+    def test_shard0_lock_is_op_lock(self):
+        """Tests and tooling that grab d._op_lock directly keep
+        serializing against client ops at any shard count."""
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                d = cluster.daemons[0]
+                assert d._op_lock is d._op_shards[0]
+                assert len({id(s) for s in d._op_shards}) == 4
+            finally:
+                cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# routing: deterministic, map-stable, consistent across entry points
+# ---------------------------------------------------------------------------
+class TestRouting:
+    def test_index_stable_and_bounded(self):
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                d = cluster.daemons[0]
+                seen = set()
+                for pgid in range(32):
+                    i = d._op_shard_index("poolX", pgid)
+                    assert i == d._op_shard_index("poolX", pgid)
+                    assert 0 <= i < 4
+                    seen.add(i)
+                # 32 pgids over 4 shards: the hash must actually
+                # spread (any single-shard collapse defeats the pool)
+                assert len(seen) > 1
+                assert (
+                    d._op_lock_for("poolX", 3)
+                    is d._op_shards[d._op_shard_index("poolX", 3)]
+                )
+            finally:
+                cluster.shutdown()
+
+    def test_dispatch_marks_item_shard(self):
+        """Every executed client op ran under the shard lock its PG
+        hashes to — dispatch and execution cannot disagree."""
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                for i in range(12):
+                    cluster.io.write_full(f"r{i}", bytes([i]) * 600)
+                for i in range(12):
+                    assert cluster.io.read(f"r{i}") == bytes([i]) * 600
+            finally:
+                cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the head-of-line regression itself
+# ---------------------------------------------------------------------------
+class TestHeadOfLine:
+    def _objects_on_distinct_shards(self, cluster, nshards):
+        """Two objects with the SAME primary daemon whose PGs hash to
+        DIFFERENT shards, plus that daemon."""
+        mon = cluster.mon
+        pool = cluster.pool
+        by_primary = {}
+        for i in range(200):
+            oid = f"hol-{i}"
+            pgid = mon.osdmap.object_to_pg(pool, oid)
+            primary = mon.osdmap.pg_primary(pool, pgid)
+            d = cluster.daemons[primary]
+            shard = d._op_shard_index(pool, pgid)
+            slots = by_primary.setdefault(primary, {})
+            slots.setdefault(shard, oid)
+            if len(slots) >= 2:
+                shards = sorted(slots)[:2]
+                return d, slots[shards[0]], slots[shards[1]]
+        pytest.fail("no two objects on distinct shards found")
+
+    def test_blocked_shard_does_not_wedge_siblings(self):
+        """Hold one shard's lock (the parked-EC-write stand-in): an
+        op on ANOTHER shard of the same daemon completes while the
+        first shard's op stays queued — the single-worker cliff is
+        gone. Then release: the queued op drains."""
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                d, oid_a, oid_b = self._objects_on_distinct_shards(
+                    cluster, 4
+                )
+                pool = cluster.pool
+                shard_a = d._op_shard_index(
+                    pool, cluster.mon.osdmap.object_to_pg(pool, oid_a)
+                )
+                lock_a = d._op_shards[shard_a]
+                done_a = cluster.io.aio_write_full(oid_a, b"A" * 700)
+                done_a.wait_for_complete(30)  # window seeded, pg peered
+                with lock_a:
+                    comp_a = cluster.io.aio_write_full(oid_a, b"a" * 700)
+                    comp_b = cluster.io.aio_write_full(oid_b, b"b" * 700)
+                    comp_b.wait_for_complete(15)
+                    assert comp_b.is_complete()
+                    # oid_a's shard is parked: its write must still be
+                    # pending (queued behind the held lock)
+                    assert not comp_a.is_complete()
+                comp_a.wait_for_complete(15)
+                assert cluster.io.read(oid_a) == b"a" * 700
+                assert cluster.io.read(oid_b) == b"b" * 700
+            finally:
+                cluster.shutdown()
+
+    def test_single_shard_still_wedges(self):
+        """The control leg: at nshards=1 the same hold blocks BOTH
+        objects — documenting exactly what the shard pool removes."""
+        cluster = _boot(1)
+        try:
+            mon, pool = cluster.mon, cluster.pool
+            prim = {}
+            for i in range(100):
+                oid = f"hol-{i}"
+                p = mon.osdmap.primary(pool, oid)
+                if p in prim and prim[p] != oid:
+                    oid_a, oid_b = prim[p], oid
+                    d = cluster.daemons[p]
+                    break
+                prim.setdefault(p, oid)
+            else:
+                pytest.fail("no two objects sharing a primary")
+            cluster.io.write_full(oid_a, b"A" * 700)
+            with d._op_lock:
+                comp_b = cluster.io.aio_write_full(oid_b, b"b" * 700)
+                time.sleep(1.0)
+                assert not comp_b.is_complete()
+            comp_b.wait_for_complete(15)
+            assert cluster.io.read(oid_b) == b"b" * 700
+        finally:
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ordering + dedup invariants under shards
+# ---------------------------------------------------------------------------
+class TestInvariants:
+    def test_same_object_appends_stay_ordered(self):
+        """Same object -> same shard -> dispatch order preserved:
+        interleaved appends land in submission order."""
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                cluster.io.write_full("seq", b"")
+                comps = [
+                    cluster.io._submit_async(
+                        cluster.pool, "seq", "append",
+                        data=bytes([65 + i]) * 4,
+                    )
+                    for i in range(8)
+                ]
+                for c in comps:
+                    c.wait_for_complete(30)
+                got = cluster.io.read("seq")
+                want = b"".join(bytes([65 + i]) * 4 for i in range(8))
+                assert got == want
+            finally:
+                cluster.shutdown()
+
+    def test_reqid_dedup_across_shards(self):
+        """The reqid window survives sharding: a replayed mutation
+        (same reqid) must not re-apply. Exercised through many
+        objects so windows live on several shards concurrently."""
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                for i in range(10):
+                    cluster.io.write_full(f"d{i}", bytes([i]) * 300)
+                    cluster.io.append(f"d{i}", b"+one")
+                for i in range(10):
+                    got = cluster.io.read(f"d{i}")
+                    assert got == bytes([i]) * 300 + b"+one"
+                # removes + re-reads: the completed-op cache is shared
+                # across shards under the reqcache leaf lock
+                for i in range(10):
+                    cluster.io.remove(f"d{i}")
+                for i in range(10):
+                    with pytest.raises(FileNotFoundError):
+                        cluster.io.read(f"d{i}")
+            finally:
+                cluster.shutdown()
+
+    def test_concurrent_writes_many_shards(self):
+        """A burst of concurrent writes across all shards settles
+        with every payload intact (the basic no-corruption sweep)."""
+        with config.override(osd_op_num_shards=4):
+            cluster = _boot(4)
+            try:
+                comps = [
+                    cluster.io.aio_write_full(f"c{i}", bytes([i]) * 800)
+                    for i in range(24)
+                ]
+                for c in comps:
+                    c.wait_for_complete(30)
+                for i in range(24):
+                    assert cluster.io.read(f"c{i}") == bytes([i]) * 800
+            finally:
+                cluster.shutdown()
+
+    def test_stop_joins_shard_workers(self):
+        with config.override(osd_op_num_shards=3):
+            cluster = _boot(3)
+            try:
+                cluster.io.write_full("bye", b"x" * 500)
+                workers = list(cluster.daemons[0]._op_shard_workers)
+                assert len(workers) == 3
+            finally:
+                cluster.shutdown()
+            deadline = time.monotonic() + 5
+            while any(w.is_alive() for w in workers):
+                if time.monotonic() > deadline:
+                    pytest.fail("shard workers failed to stop")
+                time.sleep(0.05)

@@ -1,0 +1,309 @@
+"""Shared-memory ring transport (ISSUE 20 tentpole (b) + satellite
+2): the co-located fast lane must carry the SAME Connection contract
+as TCP — framing, peer naming, teardown semantics — and the chaos
+tier must not be able to tell the transports apart: NetFaultPlane
+rules fire identically (same seed => same counter deltas) because
+faults inject on logical frames above the transport.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.msg import shm_ring
+from ceph_tpu.msg.messages import Ping, Pong
+from ceph_tpu.msg.messenger import (
+    LinkRule,
+    Messenger,
+    net_faults,
+)
+from ceph_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def clean_plane():
+    net_faults.clear()
+    net_faults.reset_counters()
+    shm_ring.reset_stats()
+    yield
+    net_faults.clear()
+    net_faults.reset_counters()
+
+
+def _pair(transport, server_name="osd.50", client_name="cli.s"):
+    srv = Messenger(server_name)
+    srv_got = []
+    srv.set_dispatcher(lambda c, m: srv_got.append(m))
+    addr = srv.bind()
+    cli = Messenger(client_name)
+    cli_got = []
+    cli.set_dispatcher(lambda c, m: cli_got.append(m))
+    with config.override(msgr_transport=transport):
+        conn = cli.connect(addr)
+    return srv, srv_got, cli, cli_got, conn
+
+
+def _wait(pred, timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# lane negotiation
+# ---------------------------------------------------------------------------
+class TestNegotiation:
+    def test_shm_lane_taken_for_in_process_peer(self):
+        srv, srv_got, cli, _cg, conn = _pair("shm_ring")
+        try:
+            assert isinstance(conn.sock, shm_ring.RingSock)
+            assert conn.peer_name == "osd.50"
+            conn.send(Ping(1, 0))
+            assert _wait(lambda: srv_got)
+            assert srv_got[0].tid == 1
+            assert shm_ring.snapshot()["connections"] == 1
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_tcp_default_untouched(self):
+        srv, srv_got, cli, _cg, conn = _pair("tcp")
+        try:
+            assert not isinstance(conn.sock, shm_ring.RingSock)
+            conn.send(Ping(2, 0))
+            assert _wait(lambda: srv_got)
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_unregistered_address_falls_back_to_tcp(self):
+        """shm_ring configured but the peer is not in-process (no
+        registry entry): the dial transparently goes TCP."""
+        srv = Messenger("osd.51")
+        got = []
+        srv.set_dispatcher(lambda c, m: got.append(m))
+        addr = srv.bind()
+        shm_ring.unregister(addr, srv)  # simulate an out-of-process peer
+        cli = Messenger("cli.f")
+        try:
+            with config.override(msgr_transport="shm_ring"):
+                conn = cli.connect(addr)
+            assert not isinstance(conn.sock, shm_ring.RingSock)
+            conn.send(Ping(3, 0))
+            assert _wait(lambda: got)
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# stream semantics: big frames, bidirectional traffic, teardown
+# ---------------------------------------------------------------------------
+class TestStream:
+    def test_pingpong_roundtrip(self):
+        srv, _sg, cli, cli_got, conn = _pair("shm_ring")
+        srv.set_dispatcher(lambda c, m: c.send(Pong(m.tid, 7)))
+        try:
+            for i in range(25):
+                conn.send(Ping(i, 0))
+            assert _wait(lambda: len(cli_got) == 25)
+            assert [m.tid for m in cli_got] == list(range(25))
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_frame_larger_than_slot(self):
+        """A frame spanning many ring slots reassembles byte-exact
+        (chunking is below the framing layer)."""
+        from ceph_tpu.msg.messages import OSDOp
+
+        srv, srv_got, cli, _cg, conn = _pair("shm_ring")
+        try:
+            data = bytes(range(256)) * 1024  # 256 KiB >> SLOT_BYTES
+            conn.send(OSDOp(9, 1, "pool", "obj", "write", data=data))
+            assert _wait(lambda: srv_got)
+            assert srv_got[0].data == data
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_send_after_shutdown_raises(self):
+        srv, _sg, cli, _cg, conn = _pair("shm_ring")
+        srv.shutdown()
+        assert _wait(lambda: not conn.alive)
+        with pytest.raises((ConnectionError, OSError)):
+            for _ in range(4):  # first sends may land in ring buffers
+                conn.send(Ping(1, 0))
+                time.sleep(0.05)
+        cli.shutdown()
+
+    def test_compressed_messenger_over_shm(self):
+        srv = Messenger("osd.52", compress=True)
+        srv_got = []
+        srv.set_dispatcher(lambda c, m: srv_got.append(m))
+        addr = srv.bind()
+        cli = Messenger("cli.z", compress=True)
+        try:
+            with config.override(msgr_transport="shm_ring"):
+                conn = cli.connect(addr)
+            assert isinstance(conn.sock, shm_ring.RingSock)
+            conn.send(Ping(4, 0))
+            assert _wait(lambda: srv_got)
+            assert srv_got[0].tid == 4
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: fault-plane parity — identical rules, seeds and
+# traffic produce identical fault counters on both transports
+# ---------------------------------------------------------------------------
+class TestFaultParity:
+    def _run_leg(self, transport, rule, n=40, seed=77):
+        srv, srv_got, cli, _cg, conn = _pair(transport)
+        try:
+            net_faults.configure(seed)
+            net_faults.add_rule("cli.s", "osd.50", rule)
+            before = dict(net_faults.counters)
+            for i in range(n):
+                conn.send(Ping(i, 0))
+            time.sleep(0.4)  # let delays/reorders flush
+            after = dict(net_faults.counters)
+            delta = {k: after[k] - before.get(k, 0) for k in after}
+            return delta, [m.tid for m in srv_got]
+        finally:
+            net_faults.clear()
+            cli.shutdown()
+            srv.shutdown()
+
+    @pytest.mark.parametrize(
+        "rule",
+        [
+            LinkRule(drop=0.5),
+            LinkRule(dup=0.4),
+            LinkRule(delay_ms=30, delay_jitter_ms=10),
+            LinkRule(drop=0.2, dup=0.2, reorder=0.3),
+        ],
+        ids=["drop", "dup", "delay", "mixed"],
+    )
+    def test_counters_match_tcp(self, rule):
+        """Same seed, same link names, same traffic: the fault plane
+        fires frame-for-frame identically over shm rings and TCP —
+        the plane sits above the transport, so the per-lane RNG
+        draws the same sequence either way."""
+        tcp_delta, tcp_tids = self._run_leg("tcp", rule)
+        net_faults.reset_counters()
+        shm_delta, shm_tids = self._run_leg("shm_ring", rule)
+        assert shm_delta == tcp_delta
+        # delivered sets match too (dup/reorder may reorder arrival,
+        # drop decides by the same draws)
+        assert sorted(shm_tids) == sorted(tcp_tids)
+
+    def test_partition_blocks_shm_link(self):
+        srv, srv_got, cli, _cg, conn = _pair("shm_ring")
+        try:
+            net_faults.configure(1)
+            net_faults.add_rule("cli.s", "osd.50", LinkRule(partition=True))
+            conn.send(Ping(1, 0))
+            time.sleep(0.25)
+            assert srv_got == []
+            assert net_faults.counters["frames_dropped"] >= 1
+            net_faults.clear()
+            conn.send(Ping(2, 0))
+            assert _wait(lambda: srv_got)
+            assert [m.tid for m in srv_got] == [2]
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_inbound_faults_fire_on_shm_reader(self):
+        """Server->client direction faults at the client's read loop
+        — same placement as TCP (the accepted end has no peer name)."""
+        srv, _sg, cli, cli_got, conn = _pair("shm_ring")
+        srv.set_dispatcher(lambda c, m: c.send(Pong(m.tid, 7)))
+        try:
+            net_faults.configure(1)
+            net_faults.add_rule("osd.50", "cli.s", LinkRule(partition=True))
+            conn.send(Ping(1, 0))
+            time.sleep(0.25)
+            assert cli_got == []
+            net_faults.clear()
+            conn.send(Ping(2, 0))
+            assert _wait(lambda: cli_got)
+            assert [m.tid for m in cli_got] == [2]
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ring unit behavior (both native and the pure-Python fallback)
+# ---------------------------------------------------------------------------
+class TestRingUnits:
+    @pytest.fixture(params=["auto", "pyring"])
+    def ring_pair(self, request):
+        if request.param == "pyring":
+            return shm_ring._PyRing(4), "pyring"
+        return shm_ring._make_ring(), "auto"
+
+    def test_push_pop_fifo(self, ring_pair):
+        ring, _ = ring_pair
+        for i in range(3):
+            assert ring.push_timed(bytes([i]) * 8, 1.0) == 1
+        for i in range(3):
+            rc, chunk = ring.pop_timed(1.0)
+            assert rc == 1 and chunk == bytes([i]) * 8
+        ring.close()
+
+    def test_pop_timeout(self, ring_pair):
+        ring, _ = ring_pair
+        rc, chunk = ring.pop_timed(0.05)
+        assert rc == -2 and chunk is None
+        ring.close()
+
+    def test_close_drains_then_eof(self, ring_pair):
+        """FIN-then-drain: buffered chunks survive close; the pop
+        after the last one reports closed (EOF), never loses data."""
+        ring, _ = ring_pair
+        assert ring.push_timed(b"last-words", 1.0) == 1
+        ring.close()
+        rc, chunk = ring.pop_timed(1.0)
+        assert rc == 1 and chunk == b"last-words"
+        rc, chunk = ring.pop_timed(1.0)
+        assert rc == 0 and chunk is None
+
+    def test_push_to_closed_ring_rejected(self, ring_pair):
+        ring, _ = ring_pair
+        ring.close()
+        assert ring.push_timed(b"x", 0.2) == 0
+
+    def test_blocked_push_wakes_on_pop(self):
+        ring = shm_ring._PyRing(1)
+        assert ring.push_timed(b"a", 0.5) == 1
+        results = []
+
+        def pusher():
+            results.append(ring.push_timed(b"b", 2.0))
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        time.sleep(0.05)
+        assert ring.pop_timed(0.5) == (1, b"a")
+        t.join(timeout=3)
+        assert results == [1]
+        assert ring.pop_timed(0.5) == (1, b"b")
+        ring.close()
+
+    def test_ringsock_recv_chunk_splitting(self):
+        a, b = shm_ring.socketpair()
+        a.settimeout(1.0)
+        b.settimeout(1.0)
+        a.sendall(b"0123456789")
+        assert b.recv(4) == b"0123"
+        assert b.recv(4) == b"4567"
+        assert b.recv(4) == b"89"
+        a.close()
+        assert b.recv(4) == b""  # EOF after drain

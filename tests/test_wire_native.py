@@ -1,0 +1,275 @@
+"""Native frame codec vs the pure-Python wire path (ISSUE 20
+tentpole (a) + satellites 1/3): the C encoder/verifier must be a
+bit-identical drop-in — same bytes out, same BadFrame taxonomy on
+corruption, same CRCs as every other checksum backend — with the
+Python path preserved as the oracle behind ``msgr_native_codec``.
+"""
+
+import struct
+import zlib
+
+import pytest
+
+from ceph_tpu import native
+from ceph_tpu.msg.wire import (
+    BadFrame,
+    CRC_SEED,
+    MAX_SEGMENTS,
+    decode_frame,
+    encode_frame,
+    frame_from_buffer,
+)
+from ceph_tpu.utils.config import config
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native tier unavailable"
+)
+
+
+def _py_frame(msg_type, seq, segments, **kw):
+    with config.override(msgr_native_codec=False):
+        return encode_frame(msg_type, seq, segments, **kw)
+
+
+def _native_frame(msg_type, seq, segments, **kw):
+    with config.override(msgr_native_codec=True):
+        return encode_frame(msg_type, seq, segments, **kw)
+
+
+def _py_decode(buf):
+    with config.override(msgr_native_codec=False):
+        return frame_from_buffer(buf)
+
+
+def _native_decode(buf):
+    with config.override(msgr_native_codec=True):
+        return frame_from_buffer(buf)
+
+
+CASES = [
+    [b"x"],
+    [b""],
+    [b"payload" * 500],
+    [b"a", b"", b"bb", b"ccc"],
+    [bytes(range(256)) * 16] * MAX_SEGMENTS,
+    [b"\x00" * 4096, b"\xff" * 333],
+]
+
+
+# ---------------------------------------------------------------------------
+# encode parity: the native assembler is bit-identical to the oracle
+# ---------------------------------------------------------------------------
+@needs_native
+class TestEncodeParity:
+    @pytest.mark.parametrize("segs", CASES)
+    def test_bit_identical_clear(self, segs):
+        assert _native_frame(9, 77, segs) == _py_frame(9, 77, segs)
+
+    @pytest.mark.parametrize("segs", CASES)
+    def test_bit_identical_compressed(self, segs):
+        a = _native_frame(9, 77, segs, compress=True)
+        b = _py_frame(9, 77, segs, compress=True)
+        assert a == b
+
+    def test_header_fields_survive(self):
+        for msg_type, seq in [(0, 0), (65535, 2**63), (112, 1)]:
+            t, s, segs = _py_decode(_native_frame(msg_type, seq, [b"p"]))
+            assert (t, s, segs) == (msg_type, seq, [b"p"])
+
+
+# ---------------------------------------------------------------------------
+# decode parity: either path decodes either path's frames ("legacy
+# frames" = python-encoded bytes through the native verifier and
+# vice versa), compression transparent, roundtrip closed
+# ---------------------------------------------------------------------------
+@needs_native
+class TestDecodeParity:
+    @pytest.mark.parametrize("segs", CASES)
+    def test_cross_decode(self, segs):
+        py = _py_frame(5, 3, segs)
+        nat = _native_frame(5, 3, segs)
+        assert _native_decode(py) == (5, 3, segs)
+        assert _py_decode(nat) == (5, 3, segs)
+
+    def test_compressed_roundtrip_both_paths(self):
+        segs = [b"Z" * 20_000, b"tail"]
+        buf = _native_frame(5, 3, segs, compress=True)
+        assert _py_decode(buf) == (5, 3, segs)
+        assert _native_decode(buf) == (5, 3, segs)
+
+    def test_streaming_decode_native(self):
+        """decode_frame's read_exact streaming entry, native armed:
+        the single table read + single payload read reassemble."""
+        segs = [b"a" * 100, b"b" * 17]
+        buf = _native_frame(5, 9, segs)
+        pos = [0]
+
+        def read_exact(n):
+            out = buf[pos[0] : pos[0] + n]
+            if len(out) != n:
+                raise EOFError
+            pos[0] += n
+            return out
+
+        with config.override(msgr_native_codec=True):
+            assert decode_frame(read_exact) == (5, 9, segs)
+        assert pos[0] == len(buf)
+
+
+# ---------------------------------------------------------------------------
+# corruption taxonomy: truncation and bit flips raise the same
+# BadFrame family through both verifiers
+# ---------------------------------------------------------------------------
+@needs_native
+class TestCorruption:
+    def test_payload_bitflip_both_paths(self):
+        buf = bytearray(_py_frame(7, 1, [b"seg-one" * 50, b"seg-two" * 50]))
+        buf[-3] ^= 0x40
+        for dec in (_py_decode, _native_decode):
+            with pytest.raises(BadFrame, match="crc"):
+                dec(bytes(buf))
+
+    def test_table_crc_bitflip(self):
+        buf = bytearray(_py_frame(7, 1, [b"payload" * 100]))
+        buf[16 + 4] ^= 0x01  # first table entry's crc field
+        for dec in (_py_decode, _native_decode):
+            with pytest.raises(BadFrame, match="crc"):
+                dec(bytes(buf))
+
+    def test_native_reports_bad_segment_index(self):
+        segs = [b"a" * 64, b"b" * 64, b"c" * 64]
+        buf = bytearray(_native_frame(7, 1, segs))
+        buf[-1] ^= 0x80  # last byte = inside segment 2
+        with pytest.raises(BadFrame, match="segment 2"):
+            _native_decode(bytes(buf))
+
+    def test_truncated_frame(self):
+        buf = _native_frame(7, 1, [b"payload" * 100])
+        for cut in (4, 15, 20, len(buf) - 1):
+            for dec in (_py_decode, _native_decode):
+                with pytest.raises((BadFrame, EOFError)):
+                    dec(buf[:cut])
+
+    def test_bad_magic_checked_before_codec(self):
+        buf = bytearray(_native_frame(7, 1, [b"x"]))
+        buf[0] ^= 0xFF
+        for dec in (_py_decode, _native_decode):
+            with pytest.raises(BadFrame, match="magic"):
+                dec(bytes(buf))
+
+    def test_compressed_corruption_caught_by_crc_first(self):
+        """Corrupt compressed bytes die at the CRC gate, never inside
+        the decompressor — on both paths."""
+        buf = bytearray(_native_frame(7, 1, [b"Q" * 30_000], compress=True))
+        buf[30] ^= 0x10
+        for dec in (_py_decode, _native_decode):
+            with pytest.raises(BadFrame, match="crc"):
+                dec(bytes(buf))
+
+
+# ---------------------------------------------------------------------------
+# secure mode: the AEAD path bypasses the codec entirely (GCM tag
+# replaces per-segment CRC) — the codec gate must not disturb it
+# ---------------------------------------------------------------------------
+class TestSecureMode:
+    def test_secure_frames_identical_with_codec_armed(self):
+        pytest.importorskip(
+            "cryptography.hazmat.primitives.ciphers.aead",
+            reason="secure mode needs the cryptography package",
+        )
+        from ceph_tpu.msg.secure import KEY_BYTES, SALT_BYTES, SecureSession
+
+        key, salt = b"k" * KEY_BYTES, b"s" * SALT_BYTES
+        segs = [b"sealed-payload" * 10]
+        tx_a = SecureSession(key, salt)
+        tx_b = SecureSession(key, salt)
+        with config.override(msgr_native_codec=True):
+            sealed_a = encode_frame(3, 8, segs, secure=tx_a)
+        with config.override(msgr_native_codec=False):
+            sealed_b = encode_frame(3, 8, segs, secure=tx_b)
+        assert sealed_a == sealed_b
+        rx = SecureSession(key, salt)
+        with config.override(msgr_native_codec=True):
+            assert frame_from_buffer(sealed_a, secure=rx) == (3, 8, segs)
+
+    def test_clear_frame_on_secure_session_still_rejected(self):
+        buf = _py_frame(3, 8, [b"x"])
+        with pytest.raises(BadFrame, match="secure-mode mismatch"):
+            frame_from_buffer(buf, secure=object())
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: CRC oracle across every checksum backend — the wire
+# CRC must be byte-identical no matter which implementation serves it
+# ---------------------------------------------------------------------------
+class TestCrcOracle:
+    VECTORS = [
+        b"",
+        b"a",
+        b"123456789",
+        bytes(range(256)),
+        b"\x00" * 4096,
+        b"payload" * 1000,
+    ]
+
+    def _backends(self):
+        from ceph_tpu.checksum import crc32c_scalar, crc32c_wire
+        from ceph_tpu.checksum.reference import crc32c_ref
+
+        backends = {
+            "wire": crc32c_wire,
+            "scalar": crc32c_scalar,
+            "ref": crc32c_ref,
+        }
+        if native.available():
+            backends["native"] = native.crc32c
+            backends["native_bytes"] = native.crc32c_bytes
+        return backends
+
+    @pytest.mark.parametrize("data", VECTORS)
+    def test_all_backends_agree(self, data):
+        got = {
+            name: fn(CRC_SEED, data) & 0xFFFFFFFF
+            for name, fn in self._backends().items()
+        }
+        assert len(set(got.values())) == 1, got
+
+    def test_wire_crc_matches_frame_table(self):
+        """The CRC the frame table carries IS crc32c_wire(seed, seg) —
+        pinned so a backend swap can never silently reframe."""
+        from ceph_tpu.checksum import crc32c_wire
+
+        seg = b"pinned-segment" * 9
+        buf = _py_frame(7, 1, [seg])
+        _len, crc = struct.unpack_from("<II", buf, 16)
+        assert crc == crc32c_wire(CRC_SEED, seg) & 0xFFFFFFFF
+
+    def test_seeded_not_plain_crc32(self):
+        seg = b"123456789"
+        from ceph_tpu.checksum import crc32c_wire
+
+        assert crc32c_wire(CRC_SEED, seg) != zlib.crc32(seg)
+
+
+# ---------------------------------------------------------------------------
+# config gate: msgr_native_codec=false forces the oracle path even
+# when the native tier is loaded
+# ---------------------------------------------------------------------------
+@needs_native
+def test_codec_gate_respected(monkeypatch):
+    from ceph_tpu.msg import wire
+
+    calls = []
+    real = native.frame_encode
+
+    def spy(*a, **kw):
+        calls.append(a)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(wire._native(), "frame_encode", spy, raising=False)
+    with config.override(msgr_native_codec=False):
+        encode_frame(7, 1, [b"x"])
+    assert not calls
+    with config.override(msgr_native_codec=True):
+        encode_frame(7, 1, [b"x"])
+    assert calls

@@ -35,6 +35,14 @@
 #                            # kill/revive churn), and the mclock/qos
 #                            # suites join the rerun set; composes
 #                            # with --chaos/--lockdep
+#   tools/soak.sh --transport 10
+#                            # messenger-v2 leg: the background
+#                            # loadgen loop rides the shm-ring lane
+#                            # with 4 op shards per OSD (the round-20
+#                            # transport tier under kill/revive
+#                            # churn), and the transport/codec/shard
+#                            # suites join the rerun set; composes
+#                            # with --chaos/--lockdep/--qos
 #   SOAK_SUITES="tests/test_cluster_peering.py" tools/soak.sh 20
 #   SOAK_NO_LOAD=1 tools/soak.sh 5   # skip the background load loop
 #
@@ -53,11 +61,13 @@ cd "$(dirname "$0")/.."
 CHAOS=""
 LOCKDEP=""
 QOS=""
+TRANSPORT=""
 while true; do
     case "${1:-}" in
         --chaos) CHAOS=1; shift ;;
         --lockdep) LOCKDEP=1; shift ;;
         --qos) QOS=1; shift ;;
+        --transport) TRANSPORT=1; shift ;;
         *) break ;;
     esac
 done
@@ -71,6 +81,9 @@ if [ -n "$LOCKDEP" ]; then
 fi
 if [ -n "$QOS" ]; then
     DEFAULT_SUITES="$DEFAULT_SUITES tests/test_mclock.py tests/test_qos.py"
+fi
+if [ -n "$TRANSPORT" ]; then
+    DEFAULT_SUITES="$DEFAULT_SUITES tests/test_shm_ring.py tests/test_wire_native.py tests/test_op_shards.py"
 fi
 SUITES=${SOAK_SUITES:-"$DEFAULT_SUITES"}
 LOAD_FLAGS=""
@@ -89,6 +102,10 @@ if [ -n "$LOCKDEP" ]; then
     # + forensics bundle and fails non-green laps)
     export CEPH_TPU_LOCKDEP=1
     LOAD_FLAGS="$LOAD_FLAGS --lockdep"
+fi
+if [ -n "$TRANSPORT" ]; then
+    # shm-ring lane + sharded op workers under the kill/revive churn
+    LOAD_FLAGS="$LOAD_FLAGS --transport shm_ring --op-shards 4"
 fi
 FORENSICS_DIR=${SOAK_FORENSICS_DIR:-/tmp/soak-forensics}
 SLOW_S=${SOAK_SLOW_CONVERGENCE_S:-45}
@@ -123,7 +140,7 @@ if [ -z "${SOAK_NO_LOAD:-}" ]; then
         done
     ) &
     LOAD_PID=$!
-    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}${LOCKDEP:+ (lockdep armed)}${QOS:+ (qos: 2 tenants)} (forensics: $FORENSICS_DIR)"
+    echo "soak: background loadgen loop pid=$LOAD_PID${CHAOS:+ (chaos: primary-kill x net_flaky)}${LOCKDEP:+ (lockdep armed)}${QOS:+ (qos: 2 tenants)}${TRANSPORT:+ (transport: shm_ring x 4 op shards)} (forensics: $FORENSICS_DIR)"
 fi
 cleanup() {
     if [ -n "$LOAD_PID" ]; then
